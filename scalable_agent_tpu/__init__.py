@@ -1,0 +1,16 @@
+"""scalable_agent_tpu: a TPU-native (JAX/XLA/pjit/Pallas) IMPALA framework.
+
+A from-scratch re-design of the capabilities of Zhehui-Huang/scalable_agent
+(DeepMind's IMPALA fork with VizDoom/Sample-Factory env support), built
+TPU-first:
+
+- Pure-functional jitted compute (model, V-trace, update) sharded over a
+  ``jax.sharding.Mesh`` — replacing TF1 graph-mode sessions.
+- V-trace as a parallel ``lax.associative_scan`` on device — replacing the
+  reference's sequential CPU ``tf.scan`` (reference: vtrace.py:250-262).
+- Host-side actor runtime (env subprocesses + dynamic-batched inference)
+  feeding the learner through a trajectory queue — replacing
+  tf.FIFOQueue/StagingArea (reference: experiment.py:531,587-597).
+"""
+
+__version__ = "0.1.0"

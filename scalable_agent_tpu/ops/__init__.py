@@ -1,0 +1,2 @@
+from scalable_agent_tpu.ops import vtrace
+from scalable_agent_tpu.ops import losses

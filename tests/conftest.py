@@ -1,0 +1,22 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any `import jax` so the backend sees the flags; pytest
+imports conftest.py before collecting test modules, which guarantees that as
+long as no test imports jax at module scope *in a file collected earlier* —
+all our test files import through this root conftest first.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep test compiles fast and deterministic.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,88 @@
+"""Loss-term tests against literal numpy formulations.
+
+(reference loss definitions: experiment.py:324-343,377-382)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu.ops import losses
+
+
+def _softmax(x, axis=-1):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def test_baseline_loss():
+    adv = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+    expected = 0.5 * np.sum(adv ** 2)
+    np.testing.assert_allclose(
+        expected, float(losses.compute_baseline_loss(adv)), rtol=1e-6)
+
+
+def test_entropy_loss():
+    rng = np.random.RandomState(0)
+    logits = rng.normal(size=(4, 3, 6)).astype(np.float32)
+    p = _softmax(logits)
+    entropy = -np.sum(p * np.log(p), axis=-1)
+    expected = -np.sum(entropy)
+    np.testing.assert_allclose(
+        expected, float(losses.compute_entropy_loss(logits)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_policy_gradient_loss():
+    rng = np.random.RandomState(1)
+    logits = rng.normal(size=(5, 2, 4)).astype(np.float32)
+    actions = rng.randint(0, 4, (5, 2)).astype(np.int32)
+    adv = rng.normal(size=(5, 2)).astype(np.float32)
+
+    p = _softmax(logits)
+    ce = -np.log(np.take_along_axis(p, actions[..., None], -1)[..., 0])
+    expected = np.sum(ce * adv)
+    np.testing.assert_allclose(
+        expected,
+        float(losses.compute_policy_gradient_loss(logits, actions, adv)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_policy_gradient_loss_stops_advantage_grad():
+    """Gradient must flow through logits only, not advantages."""
+    logits = jnp.ones((3, 2, 4))
+    actions = jnp.zeros((3, 2), jnp.int32)
+
+    def f(adv):
+        return losses.compute_policy_gradient_loss(logits, actions, adv)
+
+    g = jax.grad(f)(jnp.ones((3, 2)))
+    np.testing.assert_allclose(np.zeros((3, 2)), np.asarray(g))
+
+
+def test_clip_rewards_abs_one():
+    r = np.array([-5.0, -0.5, 0.0, 0.7, 9.0], np.float32)
+    np.testing.assert_allclose(
+        np.clip(r, -1, 1), np.asarray(losses.clip_rewards(r, "abs_one")))
+
+
+def test_clip_rewards_soft_asymmetric():
+    r = np.array([-10.0, -1.0, 0.0, 1.0, 10.0], np.float32)
+    squeezed = np.tanh(r / 5.0)
+    expected = np.where(r < 0, 0.3 * squeezed, squeezed) * 5.0
+    np.testing.assert_allclose(
+        expected, np.asarray(losses.clip_rewards(r, "soft_asymmetric")),
+        rtol=1e-4)
+    # Asymmetry: negatives shrunk harder than positives.
+    out = np.asarray(losses.clip_rewards(r, "soft_asymmetric"))
+    assert abs(out[0]) < abs(out[-1])
+
+
+def test_clip_rewards_none_and_unknown():
+    r = np.array([3.0], np.float32)
+    np.testing.assert_allclose(r, np.asarray(losses.clip_rewards(r, "none")))
+    with pytest.raises(ValueError):
+        losses.clip_rewards(r, "bogus")
