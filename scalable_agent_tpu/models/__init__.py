@@ -1,0 +1,6 @@
+from scalable_agent_tpu.models.agent import (
+    ImpalaAgent,
+    actor_step,
+    initial_state,
+)
+from scalable_agent_tpu.models.instruction import hash_instruction

@@ -1,0 +1,172 @@
+"""The IMPALA agent: conv torso + optional language LSTM + LSTM core + heads.
+
+Functional parity with the reference ``Agent`` (reference:
+experiment.py:109-237), re-designed for TPU/XLA:
+
+- The reference unrolls the LSTM with a *Python loop over tf.unstack'd
+  timesteps* because the per-step ``tf.where(done)`` state reset rules out
+  CuDNN (reference: experiment.py:225-237 and its own comment).  Here the
+  unroll is a single ``nn.scan``/``lax.scan`` — XLA compiles it to one fused
+  on-device loop, and the done-reset is a multiply by ``(1 - done)`` (the
+  initial state is zeros, so "reset to initial" == "zero the carry").
+
+- The torso runs on the whole [T*B] flattened batch at once (one big conv
+  batch for the MXU) instead of the reference's per-timestep BatchApply.
+
+- Sampling is separated from the forward pass: the model returns logits and
+  baseline; ``actor_step`` samples with an explicit PRNG key (the reference
+  samples with ``tf.multinomial`` inside ``_head``, experiment.py:205-208 —
+  implicit-RNG ops don't exist in JAX).
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from scalable_agent_tpu.models.instruction import InstructionEncoder
+from scalable_agent_tpu.models.networks import TORSOS
+from scalable_agent_tpu.types import (
+    AgentOutput,
+    AgentState,
+    StepOutput,
+    map_structure,
+)
+
+CORE_SIZE = 256  # reference: experiment.py:118
+
+
+def initial_state(batch_size: int, core_size: int = CORE_SIZE) -> AgentState:
+    """Zero LSTM carry.  (reference: experiment.py:120-121)"""
+    return AgentState(
+        c=jnp.zeros((batch_size, core_size), jnp.float32),
+        h=jnp.zeros((batch_size, core_size), jnp.float32),
+    )
+
+
+class _CoreStep(nn.Module):
+    """One LSTM-core step with done-triggered state reset.
+
+    The reset happens *before* the cell step, using the done flag of the
+    incoming env output — matching the reference exactly
+    (reference: experiment.py:230-234).
+    """
+
+    features: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        torso_out, done = xs
+        keep = (1.0 - done)[:, None]  # initial state is zeros ⇒ reset = zero
+        carry = jax.tree_util.tree_map(lambda c: keep * c, carry)
+        new_carry, y = nn.OptimizedLSTMCell(self.features, name="lstm")(
+            carry, torso_out)
+        return new_carry, y
+
+
+class ImpalaAgent(nn.Module):
+    """ConvNet/ResNet torso + LSTM(256) core + policy/baseline heads.
+
+    ``__call__`` is the whole-trajectory unroll (the reference's
+    ``Agent.unroll``, experiment.py:219-237), shared verbatim between actor
+    inference (T=1) and learner training (T=unroll_length) — exactly as the
+    reference shares one ``_build``/``unroll``.
+
+    Inputs are time-major: actions [T, B] int32, env_outputs with
+    reward [T, B], done [T, B], observation.frame [T, B, H, W, C] uint8,
+    observation.instruction [T, B, L] int32 or None.
+    """
+
+    num_actions: int
+    torso_type: str = "shallow"
+    use_instruction: bool = False
+    core_size: int = CORE_SIZE
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        actions,
+        env_outputs: StepOutput,
+        core_state: AgentState,
+    ) -> Tuple[Tuple[jax.Array, jax.Array], AgentState]:
+        unroll_len, batch = actions.shape
+        reward, _, done, observation = env_outputs
+        frame = observation.frame
+
+        # ---- Torso over the merged [T*B] batch (reference: _torso,
+        # experiment.py:148-198, but batched over all timesteps at once).
+        flat = lambda x: x.reshape((unroll_len * batch,) + x.shape[2:])
+        torso = TORSOS[self.torso_type](dtype=self.compute_dtype,
+                                        name="convnet")
+        conv_out = torso(flat(frame))  # [T*B, 256]
+
+        clipped_reward = jnp.clip(
+            jnp.asarray(flat(reward), jnp.float32), -1.0, 1.0)[:, None]
+        one_hot_last_action = jax.nn.one_hot(
+            flat(actions), self.num_actions, dtype=jnp.float32)
+        parts = [conv_out, clipped_reward, one_hot_last_action]
+        if self.use_instruction:
+            instruction = observation.instruction
+            parts.append(
+                InstructionEncoder(name="instruction")(flat(instruction)))
+        torso_out = jnp.concatenate(parts, axis=-1)
+        torso_out = torso_out.reshape((unroll_len, batch, -1))
+
+        # ---- LSTM core: one fused scan over time with done-reset
+        # (reference: experiment.py:228-237).
+        scan = nn.scan(
+            _CoreStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        carry = (core_state.c, core_state.h)
+        carry, core_outputs = scan(self.core_size, name="core")(
+            carry, (torso_out, jnp.asarray(done, jnp.float32)))
+        new_state = AgentState(c=carry[0], h=carry[1])
+
+        # ---- Heads (reference: _head, experiment.py:200-210), again on the
+        # merged batch.
+        core_flat = core_outputs.reshape((unroll_len * batch, -1))
+        policy_logits = nn.Dense(self.num_actions, name="policy_logits")(
+            core_flat).reshape((unroll_len, batch, self.num_actions))
+        baseline = nn.Dense(1, name="baseline")(core_flat).reshape(
+            (unroll_len, batch))
+        return (policy_logits, baseline), new_state
+
+
+def actor_step(
+    agent: ImpalaAgent,
+    params,
+    rng: jax.Array,
+    last_action,
+    env_output: StepOutput,
+    core_state: AgentState,
+) -> Tuple[AgentOutput, AgentState]:
+    """One batched inference step: unroll T=1, sample an action.
+
+    last_action [B] int32, env_output batched [B, ...].  Returns
+    (AgentOutput with action [B], new core state).  Jit this (it is pure);
+    the batching service calls it on gathered actor requests.
+    (reference: Agent._build, experiment.py:212-217 + _head sampling
+    :205-208)
+    """
+    expand = lambda x: x[None] if x is not None else None
+    actions = expand(last_action)
+    env_outputs = map_structure(expand, env_output)
+    (policy_logits, baseline), new_state = agent.apply(
+        params, actions, env_outputs, core_state)
+    policy_logits = policy_logits[0]  # [B, A]
+    baseline = baseline[0]  # [B]
+    action = jax.random.categorical(rng, policy_logits, axis=-1)
+    return (
+        AgentOutput(
+            action=jnp.asarray(action, jnp.int32),
+            policy_logits=policy_logits,
+            baseline=baseline,
+        ),
+        new_state,
+    )

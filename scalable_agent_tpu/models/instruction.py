@@ -1,0 +1,109 @@
+"""Language-instruction handling, split host/device.
+
+The reference hashes instruction strings to embedding buckets *inside the TF
+graph* (``tf.string_to_hash_bucket_fast``, reference: experiment.py:123-146).
+Strings cannot exist on a TPU, so the TPU-native design splits the work:
+
+- host side: ``hash_instruction`` turns a string into fixed-length int32
+  token ids (0 = padding) before the observation is ever device_put.
+- device side: ``InstructionEncoder`` (a Flax module) embeds the ids and runs
+  a small LSTM, returning the output at the last non-pad position — the same
+  "last output of a length-masked dynamic_rnn" the reference computes
+  (reference: experiment.py:142-146).
+"""
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+NUM_HASH_BUCKETS = 1000  # reference: experiment.py:131
+EMBEDDING_SIZE = 20  # reference: experiment.py:135
+LSTM_SIZE = 64  # reference: experiment.py:142
+MAX_INSTRUCTION_LEN = 16
+
+
+def hash_instruction(
+    instruction: str,
+    max_len: int = MAX_INSTRUCTION_LEN,
+    num_buckets: int = NUM_HASH_BUCKETS,
+) -> np.ndarray:
+    """Host-side: whitespace-split and hash words to 1-based bucket ids.
+
+    Returns int32 [max_len]; 0 is padding.  Bucket ids are 1..num_buckets so
+    that "no token" is distinguishable from any real token.  Uses crc32 — a
+    stable, python-version-independent hash (the reference's in-graph
+    fingerprint hash has the same "small risk of collisions" caveat,
+    reference: experiment.py:129-132).
+
+    Instructions longer than ``max_len`` words are truncated — a deliberate
+    divergence from the reference's unbounded dynamic_rnn: TPU/XLA needs
+    static shapes, and DMLab instructions are short ("go to the red door");
+    raise ``max_len`` if a level family needs more.
+    """
+    ids = np.zeros([max_len], dtype=np.int32)
+    for i, word in enumerate(instruction.split()[:max_len]):
+        ids[i] = 1 + zlib.crc32(word.encode("utf-8")) % num_buckets
+    return ids
+
+
+class _MaskedLSTMStep(nn.Module):
+    """One LSTM step that freezes the carry where mask == 0.
+
+    Freezing past the last real token makes the final carry's hidden state
+    equal the output at position length-1 — the reference's
+    ``reverse_sequence[:, 0]`` trick (reference: experiment.py:146).
+    """
+
+    features: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        x_t, m_t = xs
+        new_carry, y = nn.OptimizedLSTMCell(
+            self.features, name="cell")(carry, x_t)
+        m = m_t[:, None]
+        new_carry = jax.tree_util.tree_map(
+            lambda new, old: m * new + (1.0 - m) * old, new_carry, carry)
+        return new_carry, y
+
+
+class InstructionEncoder(nn.Module):
+    """Embed hashed token ids and LSTM-encode; output at last real token.
+
+    Input: int32 [B, L] (0 = pad).  Output: f32 [B, LSTM_SIZE].
+    (reference: experiment.py:123-146)
+    """
+
+    num_buckets: int = NUM_HASH_BUCKETS
+    embedding_size: int = EMBEDDING_SIZE
+    lstm_size: int = LSTM_SIZE
+
+    @nn.compact
+    def __call__(self, token_ids):
+        batch = token_ids.shape[0]
+        mask = (token_ids != 0).astype(jnp.float32)  # [B, L]
+        # +1: id 0 is padding; real ids are 1..num_buckets.
+        embedding = nn.Embed(self.num_buckets + 1, self.embedding_size,
+                             name="embed")(token_ids)  # [B, L, E]
+
+        scan = nn.scan(
+            _MaskedLSTMStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        carry = (
+            jnp.zeros((batch, self.lstm_size)),
+            jnp.zeros((batch, self.lstm_size)),
+        )
+        # Time-major scan over L.
+        carry, _ = scan(self.lstm_size, name="language_lstm")(
+            carry,
+            (jnp.swapaxes(embedding, 0, 1), jnp.swapaxes(mask, 0, 1)),
+        )
+        _, h = carry
+        return h

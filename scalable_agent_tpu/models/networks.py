@@ -1,0 +1,97 @@
+"""Visual torsos for the IMPALA agent.
+
+Two variants, matching the reference's two (one active, one commented-out):
+
+- ``ShallowConvTorso``: the 3-layer conv stack the fork actually runs —
+  (32, 8x8, /4), (64, 4x4, /2), (128, 3x3, /2), each ReLU, then
+  flatten → Dense(256) → ReLU (reference: experiment.py:178-189).
+- ``ResNetTorso``: the deep IMPALA ResNet the fork keeps commented out —
+  3 sections of [conv3x3 → maxpool/2 → 2 residual blocks] with channels
+  (16, 32, 32) (reference: experiment.py:156-176).
+
+TPU notes: callers flatten [T, B] into one [T*B] batch before the torso so
+every conv/matmul hits the MXU with the largest possible batch; compute can
+run in bfloat16 (``dtype``) with float32 params.
+"""
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _normalize_frame(frame, dtype):
+    """uint8 HWC frame -> [0, 1] float.  (reference: experiment.py:153-155)"""
+    return jnp.asarray(frame, dtype) / 255.0
+
+
+class ShallowConvTorso(nn.Module):
+    """(32,8,4), (64,4,2), (128,3,2) conv stack + Dense(256).
+
+    Input [N, H, W, C] uint8; output [N, 256] float32.
+    (reference: experiment.py:178-189)
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, frame):
+        x = _normalize_frame(frame, self.dtype)
+        for i, (num_ch, filter_size, stride) in enumerate(
+                [(32, 8, 4), (64, 4, 2), (128, 3, 2)]):
+            x = nn.Conv(
+                num_ch, (filter_size, filter_size), strides=(stride, stride),
+                padding="SAME", dtype=self.dtype, name=f"conv_{i}")(x)
+            x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype, name="fc")(x)
+        x = nn.relu(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+class _ResidualBlock(nn.Module):
+    num_ch: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        block_input = x
+        x = nn.relu(x)
+        x = nn.Conv(self.num_ch, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv_0")(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.num_ch, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv_1")(x)
+        return x + block_input
+
+
+class ResNetTorso(nn.Module):
+    """Deep IMPALA ResNet: sections (16, 32, 32) x 2 residual blocks.
+
+    Input [N, H, W, C] uint8; output [N, 256] float32.
+    (reference: experiment.py:156-176, commented-out variant)
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, frame):
+        x = _normalize_frame(frame, self.dtype)
+        for i, (num_ch, num_blocks) in enumerate([(16, 2), (32, 2), (32, 2)]):
+            x = nn.Conv(num_ch, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"downscale_{i}")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for j in range(num_blocks):
+                x = _ResidualBlock(num_ch, dtype=self.dtype,
+                                   name=f"residual_{i}_{j}")(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype, name="fc")(x)
+        x = nn.relu(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+TORSOS = {
+    "shallow": ShallowConvTorso,
+    "resnet": ResNetTorso,
+}

@@ -1,22 +1,33 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any `import jax` so the backend sees the flags; pytest
-imports conftest.py before collecting test modules, which guarantees that as
-long as no test imports jax at module scope *in a file collected earlier* —
-all our test files import through this root conftest first.
+Two layers of forcing are needed:
+
+1. ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` must be in the
+   environment before the CPU backend is *initialized* (it is read at client
+   creation, which is lazy — so setting it here, before any test touches
+   jax, is early enough).
+
+2. The interpreter's sitecustomize may register an experimental TPU-tunnel
+   PJRT plugin and point ``jax_platforms`` at it via ``jax.config`` — which
+   overrides the ``JAX_PLATFORMS`` env var.  ``jax.config.update`` after
+   import is the reliable override; without it, test processes block on a
+   remote TPU claim.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep test compiles fast and deterministic.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

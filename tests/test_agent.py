@@ -1,0 +1,233 @@
+"""Agent model tests.
+
+Mirrors what the reference relies on but never unit-tests (its Agent has no
+test file): unroll shapes, step/unroll equivalence, done-triggered state
+reset, and the instruction encoder's length masking.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.models import ImpalaAgent, actor_step, initial_state
+from scalable_agent_tpu.models.instruction import (
+    InstructionEncoder,
+    hash_instruction,
+)
+from scalable_agent_tpu.types import Observation, StepOutput, StepOutputInfo
+
+NUM_ACTIONS = 5
+FRAME = (16, 16, 3)
+
+
+def make_env_outputs(rng, unroll_len, batch, done=None, instruction=False):
+    frame = rng.integers(0, 256, (unroll_len, batch) + FRAME, dtype=np.uint8)
+    if done is None:
+        done = np.zeros((unroll_len, batch), bool)
+    instr = (
+        rng.integers(0, 10, (unroll_len, batch, 4), dtype=np.int32)
+        if instruction else None)
+    return StepOutput(
+        reward=rng.standard_normal((unroll_len, batch)).astype(np.float32),
+        info=StepOutputInfo(
+            episode_return=np.zeros((unroll_len, batch), np.float32),
+            episode_step=np.zeros((unroll_len, batch), np.int32)),
+        done=done,
+        observation=Observation(frame=frame, instruction=instr),
+    )
+
+
+def init_agent(**kwargs):
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS, **kwargs)
+    rng = np.random.default_rng(0)
+    env_outputs = make_env_outputs(
+        rng, 1, 1, instruction=kwargs.get("use_instruction", False))
+    actions = np.zeros((1, 1), np.int32)
+    params = agent.init(
+        jax.random.key(0), actions, env_outputs, initial_state(1))
+    return agent, params
+
+
+class TestUnroll:
+    def test_shapes(self):
+        agent, params = init_agent()
+        rng = np.random.default_rng(1)
+        unroll_len, batch = 7, 3
+        env_outputs = make_env_outputs(rng, unroll_len, batch)
+        actions = rng.integers(0, NUM_ACTIONS, (unroll_len, batch)).astype(
+            np.int32)
+        (logits, baseline), state = agent.apply(
+            params, actions, env_outputs, initial_state(batch))
+        assert logits.shape == (unroll_len, batch, NUM_ACTIONS)
+        assert baseline.shape == (unroll_len, batch)
+        assert state.c.shape == (batch, 256)
+        assert state.h.shape == (batch, 256)
+
+    def test_unroll_equals_stepwise(self):
+        """T-step unroll == T sequential 1-step unrolls (shared weights),
+
+        the property the reference gets from sharing Agent.unroll between
+        actor and learner (reference: experiment.py:212-237)."""
+        agent, params = init_agent()
+        rng = np.random.default_rng(2)
+        unroll_len, batch = 5, 2
+        done = rng.random((unroll_len, batch)) < 0.3
+        env_outputs = make_env_outputs(rng, unroll_len, batch, done=done)
+        actions = rng.integers(0, NUM_ACTIONS, (unroll_len, batch)).astype(
+            np.int32)
+
+        (full_logits, full_baseline), full_state = agent.apply(
+            params, actions, env_outputs, initial_state(batch))
+
+        state = initial_state(batch)
+        for t in range(unroll_len):
+            step_outputs = jax.tree_util.tree_map(
+                lambda x: x[t:t + 1] if x is not None else None,
+                env_outputs, is_leaf=lambda x: x is None)
+            (logits, baseline), state = agent.apply(
+                params, actions[t:t + 1], step_outputs, state)
+            np.testing.assert_allclose(
+                logits[0], full_logits[t], rtol=2e-5, atol=2e-5)
+            np.testing.assert_allclose(
+                baseline[0], full_baseline[t], rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(state.c, full_state.c, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(state.h, full_state.h, rtol=2e-5, atol=2e-5)
+
+    def test_done_resets_state(self):
+        """A done at step t erases all dependence on pre-t history
+
+        (reference: experiment.py:230-234)."""
+        agent, params = init_agent()
+        rng = np.random.default_rng(3)
+        unroll_len, batch = 4, 1
+        done = np.zeros((unroll_len, batch), bool)
+        done[2] = True  # episode boundary before step 2's core update
+        env_outputs = make_env_outputs(rng, unroll_len, batch, done=done)
+        actions = rng.integers(0, NUM_ACTIONS, (unroll_len, batch)).astype(
+            np.int32)
+
+        # Same trajectory but with a *different random* pre-boundary history.
+        alt = make_env_outputs(rng, unroll_len, batch, done=done)
+        alt_frames = np.array(alt.observation.frame)
+        alt_frames[2:] = np.asarray(env_outputs.observation.frame)[2:]
+        alt_rewards = np.array(alt.reward)
+        alt_rewards[2:] = np.asarray(env_outputs.reward)[2:]
+        alt = alt._replace(
+            reward=alt_rewards,
+            observation=alt.observation._replace(frame=alt_frames))
+        alt_actions = rng.integers(
+            0, NUM_ACTIONS, (unroll_len, batch)).astype(np.int32)
+        alt_actions[2:] = actions[2:]
+
+        (_, _), state_a = agent.apply(
+            params, actions, env_outputs, initial_state(batch))
+        (_, _), state_b = agent.apply(
+            params, alt_actions, alt, initial_state(batch))
+        # Post-boundary inputs agree ⇒ final states agree despite different
+        # pre-boundary history... but ONLY if done resets the core.
+        np.testing.assert_allclose(state_a.h, state_b.h, rtol=1e-5, atol=1e-5)
+
+        # Sanity: without the boundary the histories would diverge.
+        no_done = np.zeros((unroll_len, batch), bool)
+        (_, _), state_c = agent.apply(
+            params, actions, env_outputs._replace(done=no_done),
+            initial_state(batch))
+        (_, _), state_d = agent.apply(
+            params, alt_actions, alt._replace(done=no_done),
+            initial_state(batch))
+        assert not np.allclose(state_c.h, state_d.h, rtol=1e-5, atol=1e-5)
+
+    def test_resnet_torso(self):
+        agent, params = init_agent(torso_type="resnet")
+        rng = np.random.default_rng(4)
+        env_outputs = make_env_outputs(rng, 2, 2)
+        actions = np.zeros((2, 2), np.int32)
+        (logits, baseline), _ = agent.apply(
+            params, actions, env_outputs, initial_state(2))
+        assert logits.shape == (2, 2, NUM_ACTIONS)
+        assert baseline.shape == (2, 2)
+
+    def test_instruction_conditioning(self):
+        agent, params = init_agent(use_instruction=True)
+        rng = np.random.default_rng(5)
+        env_outputs = make_env_outputs(rng, 2, 2, instruction=True)
+        actions = np.zeros((2, 2), np.int32)
+        (logits, _), _ = agent.apply(
+            params, actions, env_outputs, initial_state(2))
+        # Different instructions must change the policy.
+        obs = env_outputs.observation
+        other = env_outputs._replace(observation=obs._replace(
+            instruction=np.asarray(obs.instruction) + 1))
+        (logits2, _), _ = agent.apply(
+            params, actions, other, initial_state(2))
+        assert not np.allclose(logits, logits2)
+
+
+class TestActorStep:
+    def test_shapes_and_determinism(self):
+        agent, params = init_agent()
+        rng = np.random.default_rng(6)
+        batch = 4
+        env_outputs = make_env_outputs(rng, 1, batch)
+        env_output = jax.tree_util.tree_map(
+            lambda x: x[0] if x is not None else None,
+            env_outputs, is_leaf=lambda x: x is None)
+        out, state = actor_step(
+            agent, params, jax.random.key(0),
+            np.zeros((batch,), np.int32), env_output, initial_state(batch))
+        assert out.action.shape == (batch,)
+        assert out.action.dtype == jnp.int32
+        assert out.policy_logits.shape == (batch, NUM_ACTIONS)
+        assert out.baseline.shape == (batch,)
+        assert state.c.shape == (batch, 256)
+        # Same key ⇒ same sample; different key ⇒ (almost surely) may differ.
+        out2, _ = actor_step(
+            agent, params, jax.random.key(0),
+            np.zeros((batch,), np.int32), env_output, initial_state(batch))
+        np.testing.assert_array_equal(out.action, out2.action)
+
+    def test_actions_within_range(self):
+        agent, params = init_agent()
+        rng = np.random.default_rng(7)
+        batch = 8
+        env_output = jax.tree_util.tree_map(
+            lambda x: x[0] if x is not None else None,
+            make_env_outputs(rng, 1, batch),
+            is_leaf=lambda x: x is None)
+        for seed in range(3):
+            out, _ = actor_step(
+                agent, params, jax.random.key(seed),
+                np.zeros((batch,), np.int32), env_output,
+                initial_state(batch))
+            assert np.all((np.asarray(out.action) >= 0)
+                          & (np.asarray(out.action) < NUM_ACTIONS))
+
+
+class TestInstructionEncoder:
+    def test_padding_is_ignored(self):
+        enc = InstructionEncoder()
+        ids = np.array([[3, 7, 0, 0]], np.int32)
+        params = enc.init(jax.random.key(0), ids)
+        out = enc.apply(params, ids)
+        assert out.shape == (1, 64)
+        # Changing only the padded tail must not change the encoding...
+        ids_b = np.array([[3, 7, 0, 0]], np.int32)
+        np.testing.assert_allclose(
+            out, enc.apply(params, ids_b), rtol=1e-6)
+        # ...while changing a real token must.
+        ids_c = np.array([[3, 9, 0, 0]], np.int32)
+        assert not np.allclose(out, enc.apply(params, ids_c))
+
+    def test_hash_instruction(self):
+        ids = hash_instruction("go to the red door")
+        assert ids.shape == (16,)
+        assert ids.dtype == np.int32
+        assert np.all(ids[:5] > 0) and np.all(ids[5:] == 0)
+        # Deterministic and word-order-sensitive.
+        np.testing.assert_array_equal(ids, hash_instruction(
+            "go to the red door"))
+        assert not np.array_equal(ids, hash_instruction(
+            "go to the blue door"))
+        # Empty instruction (Doom/Atari path) is all padding.
+        assert np.all(hash_instruction("") == 0)
