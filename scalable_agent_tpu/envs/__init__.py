@@ -1,0 +1,27 @@
+from scalable_agent_tpu.envs.core import (
+    BenchmarkStream,
+    Environment,
+    ImpalaStream,
+    StreamAdapter,
+    Wrapper,
+)
+from scalable_agent_tpu.envs.fake import FakeEnv
+from scalable_agent_tpu.envs.registry import create_env, register_family
+from scalable_agent_tpu.envs.spec import TensorSpec, spec_of
+from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.envs.worker import EnvProcess, RemoteEnvError
+
+
+def make_impala_stream(env_name: str, seed: int = 0,
+                       benchmark_mode: bool = False, **kwargs):
+    """Name -> seeded ImpalaStream; picklable via functools.partial.
+
+    The one-stop factory the actor runtime and env workers use
+    (the role of create_environment, reference: experiment.py:430-459).
+    """
+    env = create_env(env_name, **kwargs)
+    env.seed(seed)
+    stream = StreamAdapter(env)
+    if benchmark_mode:
+        stream = BenchmarkStream(stream, seed=seed)
+    return ImpalaStream(stream)

@@ -1,0 +1,110 @@
+"""Deterministic fake environment for hermetic tests and benchmarks.
+
+The reference has no fake backend — every test that needs an env spins a
+real simulator (SURVEY §4) — which makes the full actor→learner loop
+untestable without VizDoom/DMLab installed.  This env closes that gap:
+
+- Transitions are a pure function of (seed, episode_index, step_index), so
+  trajectories are reproducible golden data.
+- Rewards follow a fixed per-step schedule with a terminal bonus; episode
+  length is fixed (optionally jittered deterministically per episode).
+- Observation frames encode (episode, step, action) in their first pixels,
+  so tests can assert exactly which transition produced a frame.
+
+Also serves as the throughput benchmark backend (the role of the
+reference's `doom_benchmark` spec, envs/doom/doom_utils.py:125-129) with
+zero simulator cost.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.envs.core import Environment, make_observation
+from scalable_agent_tpu.envs.spaces import Discrete
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.types import Observation
+
+
+class FakeEnv(Environment):
+    """Deterministic episodic environment.
+
+    Reward at step t (1-based) is ``0.1 * (t % 3)``; the terminal step adds
+    +1.  Episode length = ``episode_length`` (+ per-episode deterministic
+    jitter of 0..length_jitter).  Frames are uint8 [H, W, C] with
+    pixel[0,0,0] = episode index % 256, pixel[0,1,0] = step index % 256,
+    pixel[0,2,0] = last action % 256, and the rest a cheap deterministic
+    pattern.
+    """
+
+    def __init__(
+        self,
+        height: int = 72,
+        width: int = 96,
+        channels: int = 3,
+        num_actions: int = 9,
+        episode_length: int = 10,
+        length_jitter: int = 0,
+        seed: int = 0,
+        with_instruction: bool = False,
+        instruction_len: int = 16,
+    ):
+        self._h, self._w, self._c = height, width, channels
+        self.action_space = Discrete(num_actions)
+        self._episode_length = episode_length
+        self._length_jitter = length_jitter
+        self._seed = seed
+        self._episode = -1
+        self._step = 0
+        self._with_instruction = with_instruction
+        self._instruction_len = instruction_len
+        frame_spec = TensorSpec((height, width, channels), np.uint8, "frame")
+        instr_spec = (
+            TensorSpec((instruction_len,), np.int32, "instruction")
+            if with_instruction else None)
+        self.observation_spec = Observation(
+            frame=frame_spec, instruction=instr_spec)
+
+    def seed(self, seed: Optional[int]):
+        if seed is not None:
+            self._seed = int(seed)
+
+    def _episode_len(self) -> int:
+        if self._length_jitter <= 0:
+            return self._episode_length
+        # Deterministic per-(seed, episode) jitter.
+        mix = (self._seed * 1000003 + self._episode * 7919) % (
+            self._length_jitter + 1)
+        return self._episode_length + mix
+
+    def _frame(self, action: int) -> np.ndarray:
+        base = (self._seed * 131 + self._episode * 17 + self._step * 7) % 251
+        frame = np.full((self._h, self._w, self._c), base, dtype=np.uint8)
+        frame[0, 0, 0] = self._episode % 256
+        frame[0, 1, 0] = self._step % 256
+        frame[0, 2, 0] = action % 256
+        return frame
+
+    def _observation(self, action: int) -> Observation:
+        instruction = None
+        if self._with_instruction:
+            instruction = np.zeros((self._instruction_len,), np.int32)
+            instruction[0] = 1 + (self._episode % 100)
+        return make_observation(self._frame(action), instruction)
+
+    def reset(self):
+        self._episode += 1
+        self._step = 0
+        return self._observation(action=0)
+
+    def step(self, action) -> Tuple[Observation, float, bool, dict]:
+        action = int(action)
+        if not self.action_space.contains(action):
+            raise ValueError(f"action {action} outside {self.action_space}")
+        self._step += 1
+        done = self._step >= self._episode_len()
+        reward = 0.1 * (self._step % 3) + (1.0 if done else 0.0)
+        return self._observation(action), np.float32(reward), done, {}
+
+    def render(self, mode: str = "rgb_array"):
+        return self._frame(action=0)

@@ -1,0 +1,71 @@
+"""Env construction registry with name-prefix dispatch.
+
+The reference dispatches on name prefixes — ``doom_*``/``atari_*``/
+``dmlab_*`` (reference: envs/create_env.py:1-19).  Here families register
+themselves; heavyweight simulator families are imported lazily so a missing
+pip package only fails when that family is actually requested.
+"""
+
+from typing import Callable, Dict, Optional
+
+from scalable_agent_tpu.envs.core import Environment
+
+_FACTORIES: Dict[str, Callable[..., Environment]] = {}
+
+
+def register_family(prefix: str, factory: Callable[..., Environment]):
+    """Register ``factory(full_name, **kwargs)`` for env names ``prefix*``."""
+    _FACTORIES[prefix] = factory
+
+
+def create_env(full_env_name: str, **kwargs) -> Environment:
+    """Instantiate an env by prefix-dispatched name.
+
+    (reference: envs/create_env.py:1-19)
+    """
+    for prefix, factory in sorted(
+            _FACTORIES.items(), key=lambda kv: -len(kv[0])):
+        if full_env_name.startswith(prefix):
+            return factory(full_env_name, **kwargs)
+    raise ValueError(
+        f"unknown env name {full_env_name!r}; registered prefixes: "
+        f"{sorted(_FACTORIES)}")
+
+
+def _make_fake(full_env_name: str, **kwargs) -> Environment:
+    from scalable_agent_tpu.envs.fake import FakeEnv
+
+    # e.g. fake_benchmark, fake_small.
+    if full_env_name == "fake_benchmark":
+        kwargs.setdefault("height", 72)
+        kwargs.setdefault("width", 96)
+        kwargs.setdefault("episode_length", 1000)
+    elif full_env_name == "fake_small":
+        kwargs.setdefault("height", 16)
+        kwargs.setdefault("width", 16)
+        kwargs.setdefault("episode_length", 10)
+    return FakeEnv(**kwargs)
+
+
+def _make_doom(full_env_name: str, **kwargs) -> Environment:
+    from scalable_agent_tpu.envs.doom.factory import make_doom_env
+
+    return make_doom_env(full_env_name, **kwargs)
+
+
+def _make_atari(full_env_name: str, **kwargs) -> Environment:
+    from scalable_agent_tpu.envs.atari import make_atari_env
+
+    return make_atari_env(full_env_name, **kwargs)
+
+
+def _make_dmlab(full_env_name: str, **kwargs) -> Environment:
+    from scalable_agent_tpu.envs.dmlab import make_dmlab_env
+
+    return make_dmlab_env(full_env_name, **kwargs)
+
+
+register_family("fake_", _make_fake)
+register_family("doom_", _make_doom)
+register_family("atari_", _make_atari)
+register_family("dmlab_", _make_dmlab)

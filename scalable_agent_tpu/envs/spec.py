@@ -1,0 +1,42 @@
+"""Tensor specifications for pre-declared env output shapes/dtypes.
+
+The reference declares env-method output specs statically so the TF graph
+can be built before the env subprocess exists (``_tensor_specs``,
+reference: py_process.py:30-36, environments.py:122-140).  The TPU-native
+framework needs the same thing for a different reason: actor-side
+trajectory buffers and device staging arrays are pre-allocated from these
+specs, and jitted functions need static shapes.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import numpy as np
+
+
+class TensorSpec(NamedTuple):
+    """Shape + dtype (+ debug name) of one array-valued field."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    name: str = ""
+
+    def zeros(self) -> np.ndarray:
+        return np.zeros(self.shape, dtype=self.dtype)
+
+    def validate(self, value) -> np.ndarray:
+        value = np.asarray(value)
+        if tuple(value.shape) != tuple(self.shape):
+            raise ValueError(
+                f"spec {self.name or '<unnamed>'}: shape {value.shape} != "
+                f"declared {self.shape}")
+        if np.dtype(value.dtype) != np.dtype(self.dtype):
+            raise ValueError(
+                f"spec {self.name or '<unnamed>'}: dtype {value.dtype} != "
+                f"declared {np.dtype(self.dtype)}")
+        return value
+
+
+def spec_of(value, name: str = "") -> TensorSpec:
+    """Spec describing a concrete numpy value."""
+    value = np.asarray(value)
+    return TensorSpec(shape=tuple(value.shape), dtype=value.dtype, name=name)

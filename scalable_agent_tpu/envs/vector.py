@@ -1,0 +1,281 @@
+"""Vectorized environments: N streams sharded over W worker processes.
+
+Successor of the reference's ``MultiEnv`` (reference:
+algorithms/utils/multi_env.py:42-225) re-shaped for feeding a TPU:
+
+- Each worker process hosts ``N / W`` *ImpalaStream* envs and steps them
+  sequentially; the parent scatters actions and gathers batched
+  ``StepOutput``s (same sharding idea as multi_env.py:214-218).
+- All frames land in ONE shared-memory slab laid out [N, H, W, C] — batch
+  assembly for device transfer is a single contiguous read; nothing big
+  crosses a pipe.
+- ``step_send``/``step_recv`` split lets the actor runtime overlap env
+  simulation with TPU inference (the overlap the reference buys with its
+  C++ dynamic batcher + async TF ops).
+- Episode stats are read off completed episodes' StepOutputInfo and kept
+  in a ring buffer (reference: multi_env.py:298-386 stats machinery).
+"""
+
+import multiprocessing as mp
+import pickle
+from collections import deque
+from multiprocessing import shared_memory
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from scalable_agent_tpu.envs.worker import (
+    _CLOSE,
+    _INITIAL,
+    _STEP,
+    RemoteEnvError,
+    _dumps_exception,
+)
+from scalable_agent_tpu.types import (
+    Observation,
+    StepOutput,
+    StepOutputInfo,
+)
+
+
+def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
+                     slab_shape, slab_dtype, first_index: int):
+    """Hosts a contiguous slice of the env batch.  One process, k envs."""
+    streams = []
+    shm = None
+    try:
+        try:
+            make_streams = pickle.loads(make_streams_pickled)
+            streams = [make() for make in make_streams]
+            shm = shared_memory.SharedMemory(name=shm_name)
+            slab = np.ndarray(slab_shape, slab_dtype, buffer=shm.buf)
+            conn.send((True, None))
+        except Exception as exc:
+            conn.send((False, _dumps_exception(exc)))
+            return
+
+        k = len(streams)
+        while True:
+            request = conn.recv()
+            kind = request[0]
+            try:
+                if kind == _INITIAL:
+                    rewards = np.zeros((k,), np.float32)
+                    dones = np.zeros((k,), bool)
+                    returns = np.zeros((k,), np.float32)
+                    steps = np.zeros((k,), np.int32)
+                    instructions = []
+                    for i, stream in enumerate(streams):
+                        out = stream.initial()
+                        rewards[i] = out.reward
+                        dones[i] = out.done
+                        returns[i] = out.info.episode_return
+                        steps[i] = out.info.episode_step
+                        slab[first_index + i] = out.observation.frame
+                        instructions.append(out.observation.instruction)
+                    conn.send((True, (rewards, dones, returns, steps,
+                                      _maybe_stack(instructions))))
+                elif kind == _STEP:
+                    actions = request[1]
+                    rewards = np.zeros((k,), np.float32)
+                    dones = np.zeros((k,), bool)
+                    returns = np.zeros((k,), np.float32)
+                    steps = np.zeros((k,), np.int32)
+                    instructions = []
+                    for i, stream in enumerate(streams):
+                        out = stream.step(actions[i])
+                        rewards[i] = out.reward
+                        dones[i] = out.done
+                        returns[i] = out.info.episode_return
+                        steps[i] = out.info.episode_step
+                        slab[first_index + i] = out.observation.frame
+                        instructions.append(out.observation.instruction)
+                    conn.send((True, (rewards, dones, returns, steps,
+                                      _maybe_stack(instructions))))
+                elif kind == _CLOSE:
+                    break
+                else:
+                    raise ValueError(f"unknown request kind {kind}")
+            except Exception as exc:
+                conn.send((False, _dumps_exception(exc)))
+    except (EOFError, KeyboardInterrupt):
+        pass
+    finally:
+        for stream in streams:
+            try:
+                stream.close()
+            except Exception:
+                pass
+        if shm is not None:
+            shm.close()
+        conn.close()
+
+
+def _maybe_stack(items: List) -> Optional[np.ndarray]:
+    if not items or items[0] is None:
+        return None
+    return np.stack(items)
+
+
+class MultiEnv:
+    """N ImpalaStream envs across W processes with a shared frame slab.
+
+    ``make_stream_fns``: one picklable zero-arg factory per env, each
+    returning an ImpalaStream-protocol object.  ``frame_spec`` declares the
+    per-env frame shape/dtype (all envs must agree).
+    """
+
+    def __init__(
+        self,
+        make_stream_fns: Sequence[Callable],
+        frame_spec,
+        num_workers: Optional[int] = None,
+        stats_episodes: int = 100,
+        ctx: Optional[str] = None,
+    ):
+        self.num_envs = len(make_stream_fns)
+        num_workers = min(num_workers or self.num_envs, self.num_envs)
+        # spawn, not fork: see EnvProcess — the parent runs JAX.
+        self._ctx = mp.get_context(ctx or "spawn")
+        self._frame_spec = frame_spec
+        slab_shape = (self.num_envs,) + tuple(frame_spec.shape)
+        nbytes = int(np.prod(slab_shape)
+                     * np.dtype(frame_spec.dtype).itemsize)
+        self._shm = shared_memory.SharedMemory(create=True, size=nbytes)
+        self._slab = np.ndarray(slab_shape, frame_spec.dtype,
+                                buffer=self._shm.buf)
+
+        # Shard envs over workers as evenly as possible.
+        base, extra = divmod(self.num_envs, num_workers)
+        sizes = [base + (1 if w < extra else 0) for w in range(num_workers)]
+        self._slices = []
+        self._procs = []
+        self._conns = []
+        start = 0
+        for w, size in enumerate(sizes):
+            sl = slice(start, start + size)
+            self._slices.append(sl)
+            parent_conn, child_conn = self._ctx.Pipe()
+            proc = self._ctx.Process(
+                target=_vec_worker_main,
+                args=(child_conn,
+                      pickle.dumps(list(make_stream_fns[sl])),
+                      self._shm.name, slab_shape,
+                      np.dtype(frame_spec.dtype), start),
+                daemon=True,
+            )
+            proc.start()
+            child_conn.close()
+            self._procs.append(proc)
+            self._conns.append(parent_conn)
+            start += size
+        failures = []
+        for conn in self._conns:
+            try:
+                ok, payload = conn.recv()
+            except EOFError:
+                failures.append(RemoteEnvError(
+                    "env worker died during construction (no handshake)"))
+                continue
+            if not ok:
+                failures.append(pickle.loads(payload))
+        if failures:
+            self.close()
+            raise failures[0]
+
+        # Ring buffer of (episode_return, episode_length) for finished
+        # episodes (reference: multi_env.py:298-386).
+        self.episode_stats = deque(maxlen=stats_episodes)
+        self._pending = False
+
+    # -- protocol ----------------------------------------------------------
+
+    def _gather(self) -> StepOutput:
+        rewards = np.zeros((self.num_envs,), np.float32)
+        dones = np.zeros((self.num_envs,), bool)
+        returns = np.zeros((self.num_envs,), np.float32)
+        steps = np.zeros((self.num_envs,), np.int32)
+        instructions = None
+        errors = []
+        for conn, sl in zip(self._conns, self._slices):
+            ok, payload = conn.recv()
+            if not ok:
+                errors.append(pickle.loads(payload))
+                continue
+            r, d, ret, st, instr = payload
+            rewards[sl], dones[sl], returns[sl], steps[sl] = r, d, ret, st
+            if instr is not None:
+                if instructions is None:
+                    instructions = np.zeros(
+                        (self.num_envs,) + instr.shape[1:], instr.dtype)
+                instructions[sl] = instr
+        if errors:
+            raise errors[0]
+        for i in np.nonzero(dones)[0]:
+            if steps[i] > 0:  # skip initial() pseudo-done
+                self.episode_stats.append(
+                    (float(returns[i]), int(steps[i])))
+        return StepOutput(
+            reward=rewards,
+            info=StepOutputInfo(episode_return=returns, episode_step=steps),
+            done=dones,
+            observation=Observation(
+                frame=self._slab.copy(), instruction=instructions),
+        )
+
+    def initial(self) -> StepOutput:
+        for conn in self._conns:
+            conn.send((_INITIAL,))
+        return self._gather()
+
+    def step_send(self, actions) -> None:
+        actions = np.asarray(actions)
+        if actions.shape[0] != self.num_envs:
+            raise ValueError(
+                f"got {actions.shape[0]} actions for {self.num_envs} envs")
+        for conn, sl in zip(self._conns, self._slices):
+            conn.send((_STEP, actions[sl]))
+        self._pending = True
+
+    def step_recv(self) -> StepOutput:
+        if not self._pending:
+            raise RuntimeError("step_recv without step_send")
+        self._pending = False
+        return self._gather()
+
+    def step(self, actions) -> StepOutput:
+        self.step_send(actions)
+        return self.step_recv()
+
+    def frame_slab(self) -> np.ndarray:
+        """Zero-copy [N, H, W, C] view (valid until the next step)."""
+        return self._slab
+
+    def avg_episode_return(self) -> float:
+        if not self.episode_stats:
+            return float("nan")
+        return float(np.mean([r for r, _ in self.episode_stats]))
+
+    def avg_episode_length(self) -> float:
+        if not self.episode_stats:
+            return float("nan")
+        return float(np.mean([l for _, l in self.episode_stats]))
+
+    def close(self):
+        for conn in self._conns:
+            try:
+                conn.send((_CLOSE,))
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=5)
+        for conn in self._conns:
+            conn.close()
+        self._conns, self._procs = [], []
+        if self._shm is not None:
+            self._shm.close()
+            self._shm.unlink()
+            self._shm = None

@@ -1,0 +1,385 @@
+"""Generic observation/reward/control wrappers.
+
+TPU-native re-design of the reference's wrapper library (reference:
+envs/env_wrappers.py — frame stack :58-115, skip :118-142, skip+stack
+:145-166, normalize :169-205, resize/grayscale :208-267, vertical crop
+:270-290, reward scaling :293-300, time limit :303-334, remaining-time obs
+:337-365, HWC→CHW :368-420, reward clip :423-430, episode recording
+:433-497).
+
+Differences by design:
+- Wrappers act on the canonical ``Observation`` pytree (frame +
+  optional instruction) instead of bare gym arrays.
+- Default pixel layout stays HWC: TPU convs are NHWC-native, so the
+  reference's HWC→CHW conversion (a torch-ism) is available for parity but
+  never used in the TPU path.
+- Frame stacking stacks along the channel axis so the agent's conv input
+  remains one [H, W, C*k] image — one big MXU-friendly conv instead of a
+  ragged list.
+"""
+
+import json
+import os
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from scalable_agent_tpu.envs.core import Environment, Wrapper
+from scalable_agent_tpu.envs.spec import TensorSpec
+
+
+def _resize_frame(frame: np.ndarray, height: int, width: int) -> np.ndarray:
+    try:
+        import cv2
+
+        out = cv2.resize(frame, (width, height),
+                         interpolation=cv2.INTER_AREA)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out
+    except ImportError:
+        # Nearest-neighbor numpy fallback.
+        h, w = frame.shape[:2]
+        rows = (np.arange(height) * h // height)
+        cols = (np.arange(width) * w // width)
+        return frame[rows][:, cols]
+
+
+class ResizeWrapper(Wrapper):
+    """Resize frames (optionally grayscale, optionally add channel dim).
+
+    (reference: envs/env_wrappers.py:208-267)
+    """
+
+    def __init__(self, env: Environment, height: int, width: int,
+                 grayscale: bool = False):
+        super().__init__(env)
+        self._height, self._width = height, width
+        self._grayscale = grayscale
+        frame_spec = env.observation_spec.frame
+        channels = 1 if grayscale else frame_spec.shape[-1]
+        self._spec = env.observation_spec._replace(
+            frame=TensorSpec((height, width, channels), frame_spec.dtype,
+                             frame_spec.name))
+
+    @property
+    def observation_spec(self):
+        return self._spec
+
+    def _transform(self, observation):
+        frame = observation.frame
+        if self._grayscale and frame.shape[-1] == 3:
+            frame = np.asarray(
+                frame @ np.array([0.299, 0.587, 0.114]), frame.dtype
+            )[..., None]
+        if frame.shape[:2] != (self._height, self._width):
+            frame = _resize_frame(frame, self._height, self._width)
+        return observation._replace(frame=frame)
+
+    def reset(self):
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._transform(obs), reward, done, info
+
+
+class FrameStackWrapper(Wrapper):
+    """Stack the last k frames along the channel axis.
+
+    (reference: envs/env_wrappers.py:58-115; channel-stacking instead of a
+    list so the conv torso sees one [H, W, C*k] tensor)
+    """
+
+    def __init__(self, env: Environment, stack: int):
+        super().__init__(env)
+        self._stack = stack
+        self._frames = deque(maxlen=stack)
+        frame_spec = env.observation_spec.frame
+        h, w, c = frame_spec.shape
+        self._spec = env.observation_spec._replace(
+            frame=TensorSpec((h, w, c * stack), frame_spec.dtype,
+                             frame_spec.name))
+
+    @property
+    def observation_spec(self):
+        return self._spec
+
+    def _emit(self, observation):
+        return observation._replace(
+            frame=np.concatenate(list(self._frames), axis=-1))
+
+    def reset(self):
+        observation = self.env.reset()
+        for _ in range(self._stack):
+            self._frames.append(observation.frame)
+        return self._emit(observation)
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self._frames.append(obs.frame)
+        return self._emit(obs), reward, done, info
+
+
+class SkipFramesWrapper(Wrapper):
+    """Repeat each action k times, summing rewards.
+
+    (reference: envs/env_wrappers.py:118-142)
+    """
+
+    def __init__(self, env: Environment, skip_frames: int):
+        super().__init__(env)
+        self._skip = skip_frames
+
+    def step(self, action):
+        total_reward, done, info = 0.0, False, {}
+        obs = None
+        for _ in range(self._skip):
+            obs, reward, done, info = self.env.step(action)
+            total_reward += float(reward)
+            if done:
+                break
+        return obs, np.float32(total_reward), done, info
+
+
+class SkipAndStackWrapper(Wrapper):
+    """Frameskip + stack combined.  (reference: envs/env_wrappers.py:145-166)"""
+
+    def __init__(self, env: Environment, skip_frames: int = 4,
+                 stack_frames: int = 3):
+        super().__init__(FrameStackWrapper(
+            SkipFramesWrapper(env, skip_frames), stack_frames))
+
+
+class NormalizeWrapper(Wrapper):
+    """uint8 frames -> float32 in [-1, 1].
+
+    (reference: envs/env_wrappers.py:169-205.)  NOTE: the TPU path never
+    uses this — normalization happens on-device inside the torso
+    (models/networks.py) so uint8 rides the host→TPU link at 1/4 the bytes.
+    """
+
+    def __init__(self, env: Environment):
+        super().__init__(env)
+        frame_spec = env.observation_spec.frame
+        self._spec = env.observation_spec._replace(
+            frame=TensorSpec(frame_spec.shape, np.float32, frame_spec.name))
+
+    @property
+    def observation_spec(self):
+        return self._spec
+
+    def _transform(self, observation):
+        frame = observation.frame.astype(np.float32) / 128.0 - 1.0
+        return observation._replace(frame=frame)
+
+    def reset(self):
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._transform(obs), reward, done, info
+
+
+class VerticalCropWrapper(Wrapper):
+    """Crop frames vertically to a centered band.
+
+    (reference: envs/env_wrappers.py:270-290)
+    """
+
+    def __init__(self, env: Environment, crop_h: int):
+        super().__init__(env)
+        frame_spec = env.observation_spec.frame
+        h, w, c = frame_spec.shape
+        if crop_h > h:
+            raise ValueError(f"crop_h {crop_h} > frame height {h}")
+        self._top = (h - crop_h) // 2
+        self._crop_h = crop_h
+        self._spec = env.observation_spec._replace(
+            frame=TensorSpec((crop_h, w, c), frame_spec.dtype,
+                             frame_spec.name))
+
+    @property
+    def observation_spec(self):
+        return self._spec
+
+    def _transform(self, observation):
+        frame = observation.frame[self._top:self._top + self._crop_h]
+        return observation._replace(frame=frame)
+
+    def reset(self):
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._transform(obs), reward, done, info
+
+
+class RewardScalingWrapper(Wrapper):
+    """Multiply rewards by a constant.  (reference: envs/env_wrappers.py:293-300)"""
+
+    def __init__(self, env: Environment, scale: float):
+        super().__init__(env)
+        self._scale = float(scale)
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return obs, np.float32(reward * self._scale), done, info
+
+
+class ClipRewardWrapper(Wrapper):
+    """Clip rewards to [-1, 1].  (reference: envs/env_wrappers.py:423-430)"""
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return obs, np.float32(np.clip(reward, -1.0, 1.0)), done, info
+
+
+class TimeLimitWrapper(Wrapper):
+    """Terminate episodes after a step budget (+- deterministic variation).
+
+    (reference: envs/env_wrappers.py:303-334; the reference randomizes the
+    limit per episode to decorrelate resets across a vectorized batch)
+    """
+
+    TERMINATED_BY_TIMER = "timer"
+
+    def __init__(self, env: Environment, limit: int, random_variation: int = 0,
+                 seed: int = 0):
+        super().__init__(env)
+        self._limit = limit
+        self._variation = random_variation
+        self._rng = np.random.default_rng(seed)
+        self._this_limit = limit
+        self._steps = 0
+
+    def _draw_limit(self):
+        if self._variation <= 0:
+            return self._limit
+        return int(self._limit
+                   + self._rng.integers(-self._variation, self._variation + 1))
+
+    def reset(self):
+        self._steps = 0
+        self._this_limit = self._draw_limit()
+        return self.env.reset()
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self._steps += 1
+        if not done and self._steps >= self._this_limit:
+            done = True
+            info[self.TERMINATED_BY_TIMER] = True
+        return obs, reward, done, info
+
+
+class PixelFormatWrapper(Wrapper):
+    """HWC <-> CHW conversion.
+
+    (reference: envs/env_wrappers.py:368-420.)  Exists for parity with
+    torch-layout consumers; the TPU path stays HWC (NHWC convs).
+    """
+
+    def __init__(self, env: Environment, to_format: str = "CHW"):
+        super().__init__(env)
+        if to_format != "CHW":
+            raise ValueError("only CHW conversion supported")
+        frame_spec = env.observation_spec.frame
+        h, w, c = frame_spec.shape
+        self._spec = env.observation_spec._replace(
+            frame=TensorSpec((c, h, w), frame_spec.dtype, frame_spec.name))
+
+    @property
+    def observation_spec(self):
+        return self._spec
+
+    def _transform(self, observation):
+        return observation._replace(
+            frame=np.transpose(observation.frame, (2, 0, 1)))
+
+    def reset(self):
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._transform(obs), reward, done, info
+
+
+class RecordingWrapper(Wrapper):
+    """Record episodes: frames as .npy + actions/rewards as JSON.
+
+    (reference: envs/env_wrappers.py:433-497 records PNG frames +
+    actions.json; .npy avoids an image-codec dependency)
+    """
+
+    def __init__(self, env: Environment, record_to: str):
+        super().__init__(env)
+        self._dir = record_to
+        self._episode = -1
+        self._frames = []
+        self._actions = []
+        self._rewards = []
+        os.makedirs(record_to, exist_ok=True)
+
+    def _flush(self):
+        if self._episode >= 0 and self._frames:
+            ep_dir = os.path.join(self._dir, f"episode_{self._episode:05d}")
+            os.makedirs(ep_dir, exist_ok=True)
+            np.save(os.path.join(ep_dir, "frames.npy"),
+                    np.stack(self._frames))
+            with open(os.path.join(ep_dir, "episode.json"), "w") as f:
+                json.dump({
+                    "actions": [np.asarray(a).tolist()
+                                for a in self._actions],
+                    "rewards": [float(r) for r in self._rewards],
+                }, f)
+
+    def reset(self):
+        self._flush()
+        self._episode += 1
+        self._frames, self._actions, self._rewards = [], [], []
+        observation = self.env.reset()
+        self._frames.append(np.asarray(observation.frame))
+        return observation
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self._frames.append(np.asarray(obs.frame))
+        self._actions.append(action)
+        self._rewards.append(reward)
+        return obs, reward, done, info
+
+    def close(self):
+        self._flush()
+        return self.env.close()
+
+
+class RemainingTimeWrapper(Wrapper):
+    """Expose normalized remaining time as an extra observation channel.
+
+    (reference: envs/env_wrappers.py:337-365 adds a scalar to a Dict obs;
+    here it is painted into the last channel of the frame's bottom row to
+    keep the observation a single tensor for the TPU path)
+    """
+
+    def __init__(self, env: Environment, limit: int):
+        super().__init__(env)
+        self._limit = limit
+        self._steps = 0
+
+    def _transform(self, observation):
+        frame = np.array(observation.frame)
+        fraction_left = max(0.0, 1.0 - self._steps / self._limit)
+        frame[-1, :, -1] = np.asarray(
+            fraction_left * 255, frame.dtype)
+        return observation._replace(frame=frame)
+
+    def reset(self):
+        self._steps = 0
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self._steps += 1
+        return self._transform(obs), reward, done, info

@@ -9,8 +9,6 @@ instead of graph-mode tensors.
 
 from typing import Any, NamedTuple, Optional
 
-import jax
-
 
 class StepOutputInfo(NamedTuple):
     """Episode bookkeeping carried alongside every env step.
@@ -73,5 +71,12 @@ class ActorOutput(NamedTuple):
 
 
 def map_structure(fn, *trees):
-    """``tree.map_structure`` equivalent over pytrees (None treated as leaf)."""
+    """``tree.map_structure`` equivalent over pytrees (None treated as leaf).
+
+    jax is imported lazily: env worker subprocesses import this module for
+    the pytree structs but must never pull in jax (spawn-start cost, and the
+    TPU runtime must not initialize in children).
+    """
+    import jax
+
     return jax.tree_util.tree_map(fn, *trees, is_leaf=lambda x: x is None)

@@ -1,0 +1,232 @@
+"""Environment-layer tests: FakeEnv determinism, stream semantics,
+wrappers, registry — the hermetic test surface the reference lacks
+(SURVEY §4: reference tests always need a real simulator)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import (
+    FakeEnv,
+    ImpalaStream,
+    StreamAdapter,
+    create_env,
+    make_impala_stream,
+)
+from scalable_agent_tpu.envs.core import BenchmarkStream
+from scalable_agent_tpu.envs.spaces import (
+    Box,
+    Discrete,
+    Discretized,
+    TupleSpace,
+    calc_num_actions,
+    calc_num_logits,
+)
+from scalable_agent_tpu.envs import wrappers as W
+
+
+def small_env(**kwargs):
+    kwargs.setdefault("height", 8)
+    kwargs.setdefault("width", 8)
+    kwargs.setdefault("episode_length", 4)
+    return FakeEnv(**kwargs)
+
+
+class TestFakeEnv:
+    def test_deterministic(self):
+        a, b = small_env(seed=7), small_env(seed=7)
+        obs_a, obs_b = a.reset(), b.reset()
+        np.testing.assert_array_equal(obs_a.frame, obs_b.frame)
+        for _ in range(6):
+            sa = a.step(2)
+            sb = b.step(2)
+            np.testing.assert_array_equal(sa[0].frame, sb[0].frame)
+            assert sa[1] == sb[1] and sa[2] == sb[2]
+
+    def test_episode_length_and_terminal_reward(self):
+        env = small_env(episode_length=4)
+        env.reset()
+        rewards, dones = [], []
+        for _ in range(4):
+            _, r, d, _ = env.step(0)
+            rewards.append(float(r))
+            dones.append(d)
+        assert dones == [False, False, False, True]
+        assert rewards[-1] > 1.0  # terminal bonus
+
+    def test_bad_action_raises(self):
+        env = small_env(num_actions=3)
+        env.reset()
+        with pytest.raises(ValueError):
+            env.step(5)
+
+    def test_frame_encodes_progress(self):
+        env = small_env(seed=0)
+        obs = env.reset()
+        assert obs.frame[0, 0, 0] == 0  # episode 0
+        assert obs.frame[0, 1, 0] == 0  # step 0
+        obs, _, _, _ = env.step(3)
+        assert obs.frame[0, 1, 0] == 1
+        assert obs.frame[0, 2, 0] == 3  # action encoded
+
+
+class TestStreams:
+    def test_auto_reset(self):
+        stream = StreamAdapter(small_env(episode_length=2))
+        obs0 = stream.initial()
+        _, done1, _ = stream.step(0)
+        reward, done, obs = stream.step(0)
+        assert not done1 and done
+        # After done, observation is the next episode's first frame.
+        assert obs.frame[0, 0, 0] == 1  # episode 1
+        assert obs.frame[0, 1, 0] == 0  # step 0
+
+    def test_impala_stream_accounting(self):
+        stream = ImpalaStream(StreamAdapter(small_env(episode_length=3)))
+        out = stream.initial()
+        assert out.done and out.reward == 0.0
+        assert out.info.episode_return == 0.0
+        total = 0.0
+        for t in range(3):
+            out = stream.step(0)
+            total += float(out.reward)
+            assert out.info.episode_step == t + 1
+        assert out.done
+        # Emitted info includes the final reward...
+        np.testing.assert_allclose(out.info.episode_return, total, rtol=1e-6)
+        # ...and the carried state was reset: next step starts a new count.
+        out = stream.step(0)
+        assert out.info.episode_step == 1
+        np.testing.assert_allclose(
+            out.info.episode_return, float(out.reward), rtol=1e-6)
+
+    def test_benchmark_stream_ignores_actions(self):
+        mk = lambda: BenchmarkStream(
+            StreamAdapter(small_env(seed=1)), seed=5)
+        a, b = mk(), mk()
+        a.initial(), b.initial()
+        for _ in range(5):
+            ra = a.step(0)
+            rb = b.step(3)  # different agent action, same random override
+            np.testing.assert_array_equal(
+                ra[2].frame, rb[2].frame)
+
+
+class TestRegistry:
+    def test_prefix_dispatch(self):
+        env = create_env("fake_small")
+        assert isinstance(env, FakeEnv)
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError, match="unknown env name"):
+            create_env("nope_whatever")
+
+    def test_make_impala_stream_picklable(self):
+        import pickle
+
+        fn = functools.partial(make_impala_stream, "fake_small", seed=3)
+        fn2 = pickle.loads(pickle.dumps(fn))
+        stream = fn2()
+        out = stream.initial()
+        assert out.observation.frame.shape == (16, 16, 3)
+        stream.close()
+
+
+class TestSpaces:
+    def test_discretized_grid(self):
+        sp = Discretized(11, -1.0, 1.0)
+        assert sp.to_continuous(0) == -1.0
+        assert sp.to_continuous(10) == 1.0
+        np.testing.assert_allclose(sp.to_continuous(5), 0.0, atol=1e-9)
+
+    def test_logit_and_action_counts(self):
+        composite = TupleSpace([
+            Discrete(3), Discrete(3), Discretized(21, -90, 90)])
+        assert calc_num_logits(composite) == 27
+        assert calc_num_actions(composite) == 3
+
+    def test_box_sample_contains(self):
+        sp = Box(-1.0, 1.0, (4,))
+        x = sp.sample(np.random.default_rng(0))
+        assert sp.contains(x)
+        assert not sp.contains(np.full((4,), 2.0, np.float32))
+
+
+class TestWrappers:
+    def test_resize(self):
+        env = W.ResizeWrapper(small_env(height=16, width=16), 8, 6)
+        obs = env.reset()
+        assert obs.frame.shape == (8, 6, 3)
+        assert env.observation_spec.frame.shape == (8, 6, 3)
+
+    def test_grayscale(self):
+        env = W.ResizeWrapper(small_env(), 8, 8, grayscale=True)
+        assert env.reset().frame.shape == (8, 8, 1)
+
+    def test_frame_stack(self):
+        env = W.FrameStackWrapper(small_env(), 4)
+        obs = env.reset()
+        assert obs.frame.shape == (8, 8, 12)
+        # All stacked slots equal the first frame at reset.
+        np.testing.assert_array_equal(obs.frame[..., :3], obs.frame[..., 9:])
+        obs, _, _, _ = env.step(0)
+        # Newest frame last; oldest first.
+        assert obs.frame[0, 1, 9 + 0] == 1  # newest has step=1
+
+    def test_skip_frames_sums_reward(self):
+        env = W.SkipFramesWrapper(small_env(episode_length=10), 4)
+        env.reset()
+        obs, reward, done, _ = env.step(0)
+        # Underlying rewards at steps 1..4: .1*(1%3)+.1*(2%3)+.1*(0)+.1*(1%3)
+        np.testing.assert_allclose(float(reward), 0.1 + 0.2 + 0.0 + 0.1,
+                                   rtol=1e-5)
+        assert obs.frame[0, 1, 0] == 4
+
+    def test_skip_stops_at_done(self):
+        env = W.SkipFramesWrapper(small_env(episode_length=2), 4)
+        env.reset()
+        _, _, done, _ = env.step(0)
+        assert done
+
+    def test_reward_scaling_and_clip(self):
+        env = W.RewardScalingWrapper(small_env(), 10.0)
+        env.reset()
+        _, r, _, _ = env.step(0)
+        np.testing.assert_allclose(float(r), 1.0, rtol=1e-5)
+        env = W.ClipRewardWrapper(W.RewardScalingWrapper(small_env(), 10.0))
+        env.reset()
+        _, r, _, _ = env.step(0)
+        assert float(r) == 1.0
+
+    def test_time_limit(self):
+        env = W.TimeLimitWrapper(small_env(episode_length=100), limit=3)
+        env.reset()
+        infos = [env.step(0) for _ in range(3)]
+        assert [i[2] for i in infos] == [False, False, True]
+        assert infos[-1][3].get("timer")
+
+    def test_vertical_crop(self):
+        env = W.VerticalCropWrapper(small_env(height=16, width=8), 8)
+        assert env.reset().frame.shape == (8, 8, 3)
+
+    def test_pixel_format(self):
+        env = W.PixelFormatWrapper(small_env())
+        assert env.reset().frame.shape == (3, 8, 8)
+
+    def test_recording(self, tmp_path):
+        env = W.RecordingWrapper(small_env(episode_length=2),
+                                 str(tmp_path))
+        env.reset()
+        env.step(1)
+        env.step(0)
+        env.reset()  # flush episode 0
+        env.close()
+        frames = np.load(tmp_path / "episode_00000" / "frames.npy")
+        assert frames.shape == (3, 8, 8, 3)
+        import json
+
+        meta = json.loads(
+            (tmp_path / "episode_00000" / "episode.json").read_text())
+        assert meta["actions"] == [1, 0]
+        assert len(meta["rewards"]) == 2

@@ -1,0 +1,166 @@
+"""Process-isolation and vectorization tests.
+
+Ports the reference's py_process lifecycle/error-path coverage (reference:
+py_process_test.py:33-221) to the TPU-native worker design, plus MultiEnv
+batching/stats coverage (reference has none for the IMPALA path).
+"""
+
+import functools
+import pickle
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import (
+    EnvProcess,
+    MultiEnv,
+    RemoteEnvError,
+    make_impala_stream,
+)
+from scalable_agent_tpu.envs.spec import TensorSpec
+
+
+def make_small_stream(seed=0):
+    return make_impala_stream("fake_small", seed=seed)
+
+
+FRAME_SPEC = TensorSpec((16, 16, 3), np.uint8, "frame")
+
+
+class _ExplodingStream:
+    observation_spec = None
+    action_space = None
+
+    def __init__(self, where):
+        if where == "init":
+            raise RuntimeError("boom in constructor")
+        self._where = where
+
+    def initial(self):
+        return make_small_stream().initial()
+
+    def step(self, action):
+        raise RuntimeError("boom in step")
+
+    def close(self):
+        pass
+
+
+class TestEnvProcess:
+    def test_roundtrip_with_shared_memory(self):
+        with EnvProcess(make_small_stream, frame_spec=FRAME_SPEC) as proc:
+            out = proc.initial()
+            assert out.observation.frame.shape == (16, 16, 3)
+            ref = make_small_stream()
+            ref.initial()
+            for t in range(12):
+                got = proc.step(1)
+                want = ref.step(1)
+                assert float(got.reward) == float(want.reward)
+                assert bool(got.done) == bool(want.done)
+                np.testing.assert_array_equal(
+                    got.observation.frame, want.observation.frame)
+
+    def test_roundtrip_without_shared_memory(self):
+        with EnvProcess(make_small_stream) as proc:
+            out = proc.initial()
+            assert out.observation.frame.shape == (16, 16, 3)
+
+    def test_constructor_error_propagates(self):
+        proc = EnvProcess(functools.partial(_ExplodingStream, "init"))
+        with pytest.raises(RemoteEnvError, match="boom in constructor"):
+            proc.start()
+
+    def test_method_error_propagates_and_proc_survives(self):
+        with EnvProcess(functools.partial(_ExplodingStream, "step")) as proc:
+            proc.initial()
+            with pytest.raises(RemoteEnvError, match="boom in step"):
+                proc.step(0)
+            # Worker loop continues after a marshalled exception.
+            out = proc.initial()
+            assert out.observation.frame is not None
+
+    def test_async_split(self):
+        with EnvProcess(make_small_stream, frame_spec=FRAME_SPEC) as proc:
+            proc.initial()
+            proc.step_send(0)
+            out = proc.step_recv()
+            assert out.observation.frame.shape == (16, 16, 3)
+
+    def test_close_idempotent(self):
+        proc = EnvProcess(make_small_stream).start()
+        proc.initial()
+        proc.close()
+        proc.close()
+        assert not proc.alive
+
+
+class TestMultiEnv:
+    def _make(self, n, workers):
+        fns = [functools.partial(make_impala_stream, "fake_small", seed=i)
+               for i in range(n)]
+        return MultiEnv(fns, FRAME_SPEC, num_workers=workers)
+
+    def test_batched_step_matches_single_envs(self):
+        n = 6
+        vec = self._make(n, workers=3)
+        try:
+            out = vec.initial()
+            assert out.observation.frame.shape == (n, 16, 16, 3)
+            refs = [make_impala_stream("fake_small", seed=i)
+                    for i in range(n)]
+            for ref in refs:
+                ref.initial()
+            actions = np.arange(n) % 5
+            for _ in range(15):
+                got = vec.step(actions)
+                for i, ref in enumerate(refs):
+                    want = ref.step(actions[i])
+                    assert float(got.reward[i]) == float(want.reward)
+                    assert bool(got.done[i]) == bool(want.done)
+                    np.testing.assert_array_equal(
+                        got.observation.frame[i], want.observation.frame)
+        finally:
+            vec.close()
+
+    def test_episode_stats_collected(self):
+        vec = self._make(4, workers=2)
+        try:
+            vec.initial()
+            for _ in range(25):  # episodes are 10 steps
+                vec.step(np.zeros(4, np.int64))
+            assert len(vec.episode_stats) >= 8
+            # fake_small: 10 steps of .1*(t%3) + terminal 1.0
+            per_episode = sum(0.1 * (t % 3) for t in range(1, 11)) + 1.0
+            np.testing.assert_allclose(
+                vec.avg_episode_return(), per_episode, rtol=1e-5)
+            assert vec.avg_episode_length() == 10
+        finally:
+            vec.close()
+
+    def test_worker_error_propagates(self):
+        fns = [make_small_stream,
+               functools.partial(_ExplodingStream, "step")]
+        vec = MultiEnv(fns, FRAME_SPEC, num_workers=2)
+        try:
+            vec.initial()
+            with pytest.raises(RemoteEnvError, match="boom in step"):
+                vec.step(np.zeros(2, np.int64))
+        finally:
+            vec.close()
+
+    def test_constructor_error_fails_fast(self):
+        fns = [make_small_stream,
+               functools.partial(_ExplodingStream, "init")]
+        with pytest.raises(RemoteEnvError, match="boom in constructor"):
+            MultiEnv(fns, FRAME_SPEC, num_workers=2)
+
+    def test_uneven_sharding(self):
+        vec = self._make(5, workers=2)
+        try:
+            out = vec.initial()
+            assert out.observation.frame.shape[0] == 5
+            out = vec.step(np.zeros(5, np.int64))
+            assert out.reward.shape == (5,)
+        finally:
+            vec.close()
