@@ -1,0 +1,68 @@
+"""Benchmark: learner env-frames/sec on one chip.
+
+Measures the steady-state jitted IMPALA update (target-policy unroll +
+V-trace + losses + RMSProp) at the reference's production shapes —
+unroll_length=100, batch_size=32, 72x96 uint8 frames, 4 action repeats
+(reference: experiment.py:61-95) — and reports environment frames consumed
+per second per chip (frames counted x action repeats, matching the
+reference's global step, experiment.py:417-420).
+
+Baseline: 30,000 env-frames/s — the IMPALA paper's single-GPU learner
+throughput on DMLab with the shallow model (arXiv:1802.01561 via
+README.md:85; BASELINE.md north-star "learner env-frames/sec/chip >=
+published single-GPU IMPALA learner throughput per chip").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_FPS = 30000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _example_trajectory
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+
+    unroll_len, batch, height, width = 100, 32, 72, 96
+    num_actions, repeats = 9, 4
+    frames_per_update = batch * unroll_len * repeats
+
+    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16)
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    learner = Learner(agent, LearnerHyperparams(), mesh,
+                      frames_per_update=frames_per_update)
+    traj_host = _example_trajectory(
+        unroll_len, batch, height, width, num_actions)
+    state = learner.init(jax.random.key(0), traj_host)
+    traj = learner.put_trajectory(traj_host)
+
+    # Warm up (compile) then measure steady state.
+    state, metrics = learner.update(state, traj)
+    jax.block_until_ready(metrics["total_loss"])
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = learner.update(state, traj)
+    jax.block_until_ready(metrics["total_loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    fps = frames_per_update / dt
+    print(json.dumps({
+        "metric": "learner_env_frames_per_sec_per_chip",
+        "value": round(fps, 1),
+        "unit": "env_frames/s",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""One dataclass-based config for the whole framework.
+
+Replaces the reference's two coexisting systems — tf.app.flags
+(reference: experiment.py:49-95) and SF argparse with per-env overrides +
+cfg.json persistence (reference: algorithms/utils/arguments.py:27-99) —
+with a single dataclass: reference hyperparameter names/defaults are kept
+verbatim so parity runs transfer unchanged, JSON round-trips to
+``<logdir>/config.json``, and env families can override defaults through
+``apply_env_overrides``.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Config:
+    # -- run control (reference: experiment.py:49-60)
+    mode: str = "train"  # train | test
+    logdir: str = "/tmp/agent"
+    level_name: str = "fake_benchmark"
+    seed: int = 1
+
+    # -- training sizes (reference: experiment.py:61-72)
+    num_actors: int = 64  # total env count across groups
+    batch_size: int = 32
+    unroll_length: int = 100
+    num_action_repeats: int = 4
+    total_environment_frames: float = 1e9
+
+    # -- loss (reference: experiment.py:73-81)
+    entropy_cost: float = 0.00025
+    baseline_cost: float = 0.5
+    discounting: float = 0.99
+    reward_clipping: str = "abs_one"  # abs_one | soft_asymmetric | none
+
+    # -- optimizer (reference: experiment.py:89-95)
+    learning_rate: float = 0.00048
+    rmsprop_decay: float = 0.99
+    rmsprop_momentum: float = 0.0
+    rmsprop_epsilon: float = 0.1
+
+    # -- env (reference: experiment.py:82-88)
+    width: int = 96
+    height: int = 72
+    benchmark_mode: bool = False
+    num_env_workers_per_group: int = 8
+
+    # -- eval (reference: experiment.py:57-58)
+    test_num_episodes: int = 10
+
+    # -- TPU-native knobs (no reference equivalent)
+    torso_type: str = "shallow"  # shallow | resnet
+    compute_dtype: str = "bfloat16"  # conv compute dtype on TPU
+    use_instruction: bool = False
+    num_actor_groups: int = 2  # groups alternate env-sim vs TPU inference
+    mesh_data: int = 0  # 0 = all devices
+    mesh_model: int = 1
+    scan_impl: str = "associative"  # vtrace scan: associative | sequential
+    checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
+    checkpoint_keep: int = 5
+    log_interval_s: float = 10.0
+
+    # -------------------------------------------------------------------
+
+    def group_size(self) -> int:
+        """Envs per actor group == learner batch (minimum slice layout)."""
+        return self.batch_size
+
+    def frames_per_update(self) -> int:
+        """(reference: experiment.py:417-420)"""
+        return (self.batch_size * self.unroll_length
+                * self.num_action_repeats)
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Persist to JSON (the reference's cfg.json,
+        algorithms/utils/agent.py:190-193)."""
+        path = path or os.path.join(self.logdir, "config.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def from_checkpoint_dir(cls, logdir: str, **overrides) -> "Config":
+        """Load a run's persisted config, applying CLI overrides on top
+        (the reference's checkpoint-config precedence,
+        arguments.py:69-89)."""
+        path = os.path.join(logdir, "config.json")
+        config = cls.load(path) if os.path.exists(path) else cls()
+        return dataclasses.replace(config, logdir=logdir, **overrides)
+
+
+# Per-env-family default overrides (the reference's
+# env_override_defaults / *_params.py pattern, envs/env_config.py:1-24).
+_ENV_OVERRIDES = {
+    "doom_": {"width": 128, "height": 72, "num_action_repeats": 4},
+    "atari_": {"width": 84, "height": 84, "num_action_repeats": 4},
+    "dmlab_": {"width": 96, "height": 72, "num_action_repeats": 4},
+}
+
+
+def apply_env_overrides(config: Config) -> Config:
+    for prefix, overrides in _ENV_OVERRIDES.items():
+        if config.level_name.startswith(prefix):
+            defaults = Config()
+            fields = {
+                k: v for k, v in overrides.items()
+                # CLI-set values win over family defaults.
+                if getattr(config, k) == getattr(defaults, k)
+            }
+            return dataclasses.replace(config, **fields)
+    return config

@@ -1,0 +1,375 @@
+"""Experiment driver: train/test entry points and CLI.
+
+The role of the reference's ``experiment.py`` driver (reference:
+experiment.py:479-733) without its TF1 machinery: no sessions, no in-graph
+queues — a host loop wiring ActorPool → device prefetch → Learner, with
+checkpointing, metrics, and DMLab-30 scoring.
+
+Run:
+    python -m scalable_agent_tpu.driver --mode=train \
+        --level_name=fake_benchmark --total_environment_frames=100000
+    python -m scalable_agent_tpu.driver --mode=test --logdir=...
+"""
+
+import argparse
+import dataclasses
+import functools
+import queue as queue_lib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.config import Config, apply_env_overrides
+from scalable_agent_tpu.envs import (
+    MultiEnv,
+    create_env,
+    make_impala_stream,
+)
+from scalable_agent_tpu.envs import dmlab30
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.models import ImpalaAgent, actor_step, initial_state
+from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+from scalable_agent_tpu.runtime import (
+    ActorPool,
+    Learner,
+    LearnerHyperparams,
+    TrainState,
+    Trajectory,
+)
+from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
+from scalable_agent_tpu.runtime.metrics import MetricsWriter
+from scalable_agent_tpu.types import (
+    AgentOutput,
+    AgentState,
+    Observation,
+    StepOutput,
+    StepOutputInfo,
+)
+from scalable_agent_tpu.utils import Timing, log
+
+
+def env_kwargs(config: Config) -> dict:
+    """Per-family constructor kwargs (the reference threads width/height/
+    etc. through create_environment, experiment.py:430-459)."""
+    if config.level_name.startswith("fake_"):
+        return {"height": config.height, "width": config.width,
+                "with_instruction": config.use_instruction}
+    return {}
+
+
+def build_agent(config: Config, num_actions: int) -> ImpalaAgent:
+    return ImpalaAgent(
+        num_actions=num_actions,
+        torso_type=config.torso_type,
+        use_instruction=config.use_instruction,
+        compute_dtype=jnp.dtype(config.compute_dtype),
+    )
+
+
+def probe_env(config: Config):
+    """Open one env to read its specs, then tear it down."""
+    env = create_env(config.level_name, **env_kwargs(config))
+    try:
+        return env.observation_spec, env.action_space
+    finally:
+        env.close()
+
+
+def zero_trajectory(config: Config, observation_spec, num_actions: int,
+                    batch: int = 1) -> Trajectory:
+    """All-zeros [2, batch] trajectory for shape-only initialization."""
+    t_plus_1 = 2
+    frame_spec = observation_spec.frame
+
+    def zeros(shape, dtype):
+        return np.zeros((t_plus_1, batch) + tuple(shape), dtype)
+
+    instruction = None
+    if observation_spec.instruction is not None:
+        instr_spec = observation_spec.instruction
+        instruction = zeros(instr_spec.shape, instr_spec.dtype)
+    return Trajectory(
+        agent_state=AgentState(
+            c=np.zeros((batch, 256), np.float32),
+            h=np.zeros((batch, 256), np.float32)),
+        env_outputs=StepOutput(
+            reward=zeros((), np.float32),
+            info=StepOutputInfo(
+                episode_return=zeros((), np.float32),
+                episode_step=zeros((), np.int32)),
+            done=zeros((), bool),
+            observation=Observation(
+                frame=zeros(frame_spec.shape, frame_spec.dtype),
+                instruction=instruction),
+        ),
+        agent_outputs=AgentOutput(
+            action=zeros((), np.int32),
+            policy_logits=zeros((num_actions,), np.float32),
+            baseline=zeros((), np.float32)),
+    )
+
+
+def make_env_groups(config: Config) -> List[MultiEnv]:
+    """num_actors envs as groups of batch_size (each group = one learner
+    batch; >= 2 groups so env simulation and TPU inference overlap)."""
+    group_size = config.group_size()
+    num_groups = max(1, config.num_actors // group_size)
+    frame_spec = TensorSpec(
+        (config.height, config.width, 3), np.uint8, "frame")
+    groups = []
+    for g in range(num_groups):
+        fns = [
+            functools.partial(
+                make_impala_stream, config.level_name,
+                seed=config.seed * 100000 + g * 1000 + i,
+                benchmark_mode=config.benchmark_mode,
+                **env_kwargs(config))
+            for i in range(group_size)
+        ]
+        groups.append(MultiEnv(
+            fns, frame_spec,
+            num_workers=config.num_env_workers_per_group))
+    return groups
+
+
+def to_trajectory(actor_output) -> Trajectory:
+    return Trajectory(
+        agent_state=actor_output.agent_state,
+        env_outputs=actor_output.env_outputs,
+        agent_outputs=actor_output.agent_outputs,
+    )
+
+
+def train(config: Config) -> Dict[str, float]:
+    """Train until total_environment_frames.  Returns final metrics."""
+    config = apply_env_overrides(config)
+    config.save()
+    observation_spec, action_space = probe_env(config)
+    num_actions = action_space.n
+    agent = build_agent(config, num_actions)
+
+    import math
+
+    n_devices = len(jax.devices())
+    # The batch axis shards over 'data': pick the largest data-axis size
+    # that divides the batch (a 4-batch debug run on an 8-device mesh uses
+    # 4 of them rather than failing).
+    mesh_data = config.mesh_data or math.gcd(config.batch_size, n_devices)
+    if config.batch_size % mesh_data:
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by data-axis "
+            f"size {mesh_data}")
+    devices = jax.devices()[:mesh_data * config.mesh_model]
+    mesh = make_mesh(MeshSpec(data=mesh_data, model=config.mesh_model),
+                     devices=devices)
+    hp = LearnerHyperparams(
+        entropy_cost=config.entropy_cost,
+        baseline_cost=config.baseline_cost,
+        discounting=config.discounting,
+        reward_clipping=config.reward_clipping,
+        learning_rate=config.learning_rate,
+        total_environment_frames=config.total_environment_frames,
+        rmsprop_decay=config.rmsprop_decay,
+        rmsprop_momentum=config.rmsprop_momentum,
+        rmsprop_epsilon=config.rmsprop_epsilon,
+    )
+    learner = Learner(agent, hp, mesh, config.frames_per_update(),
+                      scan_impl=config.scan_impl)
+
+    ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
+                             config.checkpoint_keep)
+    example = zero_trajectory(config, observation_spec, num_actions)
+    state = learner.init(jax.random.key(config.seed), example)
+    restored = ckpt.restore(target=state)
+    if restored is not None:
+        start_updates, host_state = restored
+        state = jax.device_put(host_state, learner._replicated)
+        log.info("restored checkpoint at update %d (%.0f frames)",
+                 start_updates, float(np.asarray(state.env_frames)))
+    else:
+        start_updates = 0
+
+    env_groups = make_env_groups(config)
+    pool = ActorPool(agent, env_groups, config.unroll_length,
+                     level_name=config.level_name, seed=config.seed)
+    pool.set_params(state.params)
+    pool.start()
+
+    # Device prefetch stage: stages the next batch while the current update
+    # runs (the reference's StagingArea +1-step policy lag,
+    # experiment.py:587-597).
+    staged: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+    prefetch_stop = threading.Event()
+
+    def prefetch_loop():
+        try:
+            while not prefetch_stop.is_set():
+                try:
+                    out = pool.get_trajectory(timeout=0.5)
+                except queue_lib.Empty:
+                    continue
+                traj = learner.put_trajectory(to_trajectory(out))
+                while not prefetch_stop.is_set():
+                    try:
+                        staged.put(traj, timeout=0.5)
+                        break
+                    except queue_lib.Full:
+                        continue
+        except Exception as exc:  # surface in the main loop
+            staged.put(exc)
+
+    prefetch_thread = threading.Thread(target=prefetch_loop, daemon=True)
+    prefetch_thread.start()
+
+    writer = MetricsWriter(config.logdir)
+    timing = Timing()
+    updates = start_updates
+    frames_per_update = config.frames_per_update()
+    frames = updates * frames_per_update
+    last_log = time.monotonic()
+    frames_at_last_log = frames
+    metrics = {}
+    try:
+        while frames < config.total_environment_frames:
+            with timing.time_avg("wait_batch"):
+                traj = staged.get()
+            if isinstance(traj, Exception):
+                raise traj
+            with timing.time_avg("update"):
+                state, metrics = learner.update(state, traj)
+            pool.set_params(state.params, version=updates)
+            updates += 1
+            frames = updates * frames_per_update
+
+            now = time.monotonic()
+            if now - last_log >= config.log_interval_s:
+                host_metrics = {k: float(np.asarray(v))
+                                for k, v in metrics.items()}
+                fps = (frames - frames_at_last_log) / (now - last_log)
+                host_metrics["fps"] = fps
+                stats = pool.episode_stats()
+                if stats:
+                    host_metrics["episode_return"] = float(
+                        np.mean([r for r, _ in stats]))
+                    host_metrics["episode_frames"] = float(
+                        np.mean([l for _, l in stats])
+                        * config.num_action_repeats)
+                writer.write(updates, host_metrics)
+                log.info(
+                    "update %d frames %.3g fps %.0f loss %.3f return %s | %s",
+                    updates, frames, fps,
+                    host_metrics.get("total_loss", float("nan")),
+                    f"{host_metrics.get('episode_return', float('nan')):.2f}",
+                    timing)
+                last_log, frames_at_last_log = now, frames
+            ckpt.maybe_save(updates, state)
+        ckpt.maybe_save(updates, state, force=True)
+    finally:
+        prefetch_stop.set()
+        pool.stop()
+        prefetch_thread.join(timeout=5)
+        writer.close()
+        ckpt.close()
+    return {k: float(np.asarray(v)) for k, v in metrics.items()}
+
+
+def test(config: Config) -> Dict[str, List[float]]:
+    """Evaluate a checkpoint for test_num_episodes per level.
+
+    (reference: experiment.py:675-708)
+    """
+    config = apply_env_overrides(config)
+    observation_spec, action_space = probe_env(config)
+    num_actions = action_space.n
+    agent = build_agent(config, num_actions)
+
+    # Restore against a structure template so optimizer-state NamedTuples
+    # come back typed (only params are used here, but the checkpoint holds
+    # the full TrainState).
+    mesh = make_mesh(MeshSpec(data=len(jax.devices()), model=1))
+    hp = LearnerHyperparams()
+    learner = Learner(agent, hp, mesh, config.frames_per_update())
+    template = learner.init(
+        jax.random.key(0),
+        zero_trajectory(config, observation_spec, num_actions))
+    ckpt = CheckpointManager(config.logdir)
+    restored = ckpt.restore(target=template)
+    if restored is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {config.logdir}/checkpoints")
+    _, host_state = restored
+    params = host_state.params
+
+    step_fn = jax.jit(
+        lambda params, rng, action, env_output, state: actor_step(
+            agent, params, rng, action, env_output, state))
+
+    level_returns: Dict[str, List[float]] = {config.level_name: []}
+    stream = make_impala_stream(
+        config.level_name, seed=config.seed, **env_kwargs(config))
+    try:
+        output = stream.initial()
+        core_state = initial_state(1, agent.core_size)
+        action = np.zeros((1,), np.int32)
+        rng = jax.random.key(config.seed)
+        step_index = 0
+        while len(level_returns[config.level_name]) < config.test_num_episodes:
+            step_index += 1
+            batched = jax.tree_util.tree_map(
+                lambda x: None if x is None else np.asarray(x)[None],
+                output, is_leaf=lambda x: x is None)
+            agent_out, core_state = step_fn(
+                params, jax.random.fold_in(rng, step_index), action,
+                batched, core_state)
+            action = np.asarray(agent_out.action)
+            output = stream.step(int(action[0]))
+            if output.done:
+                level_returns[config.level_name].append(
+                    float(output.info.episode_return))
+    finally:
+        stream.close()
+
+    returns = level_returns[config.level_name]
+    log.info("level %s: mean return %.2f over %d episodes",
+             config.level_name, float(np.mean(returns)), len(returns))
+    if config.level_name in dmlab30.ALL_LEVELS:
+        # Single-level runs can't produce the full-suite score; log the
+        # per-level normalized value (reference computes the suite mean,
+        # experiment.py:703-708).
+        record = dmlab30.LEVELS.get(
+            config.level_name,
+            dmlab30._BY_TEST_NAME.get(config.level_name))
+        if record:
+            normalized = (np.mean(returns) - record.random) / (
+                record.human - record.random) * 100.0
+            log.info("human-normalized: %.2f%%", normalized)
+    return level_returns
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    for field in dataclasses.fields(Config):
+        arg_type = type(field.default)
+        if arg_type is bool:
+            parser.add_argument(
+                f"--{field.name}", type=lambda v: v.lower() in
+                ("1", "true", "yes"), default=field.default)
+        else:
+            parser.add_argument(
+                f"--{field.name}", type=arg_type, default=field.default)
+    args = parser.parse_args(argv)
+    config = Config(**vars(args))
+    if config.mode == "train":
+        train(config)
+    elif config.mode == "test":
+        test(config)
+    else:
+        raise ValueError(f"unknown mode {config.mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,22 +47,32 @@ def _make_fake(full_env_name: str, **kwargs) -> Environment:
     return FakeEnv(**kwargs)
 
 
-def _make_doom(full_env_name: str, **kwargs) -> Environment:
-    from scalable_agent_tpu.envs.doom.factory import make_doom_env
+def _lazy_family(family: str, module: str, attr: str):
+    """Factory that imports its simulator module on first use and turns a
+    missing module/pip package into a clear error instead of a raw
+    ModuleNotFoundError deep inside an env worker."""
 
-    return make_doom_env(full_env_name, **kwargs)
+    def factory(full_env_name: str, **kwargs) -> Environment:
+        import importlib
+
+        try:
+            mod = importlib.import_module(module)
+        except ImportError as exc:
+            raise ValueError(
+                f"env family {family!r} is not available here: importing "
+                f"{module} failed ({exc}).  Its simulator package is an "
+                f"optional dependency.") from exc
+        return getattr(mod, attr)(full_env_name, **kwargs)
+
+    return factory
 
 
-def _make_atari(full_env_name: str, **kwargs) -> Environment:
-    from scalable_agent_tpu.envs.atari import make_atari_env
-
-    return make_atari_env(full_env_name, **kwargs)
-
-
-def _make_dmlab(full_env_name: str, **kwargs) -> Environment:
-    from scalable_agent_tpu.envs.dmlab import make_dmlab_env
-
-    return make_dmlab_env(full_env_name, **kwargs)
+_make_doom = _lazy_family(
+    "doom_", "scalable_agent_tpu.envs.doom.factory", "make_doom_env")
+_make_atari = _lazy_family(
+    "atari_", "scalable_agent_tpu.envs.atari", "make_atari_env")
+_make_dmlab = _lazy_family(
+    "dmlab_", "scalable_agent_tpu.envs.dmlab", "make_dmlab_env")
 
 
 register_family("fake_", _make_fake)

@@ -55,43 +55,36 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
             return
 
         k = len(streams)
+
+        def run_all(step_of_stream):
+            """Apply per-stream, gather small fields, frames -> slab."""
+            rewards = np.zeros((k,), np.float32)
+            dones = np.zeros((k,), bool)
+            returns = np.zeros((k,), np.float32)
+            steps = np.zeros((k,), np.int32)
+            instructions = []
+            for i, stream in enumerate(streams):
+                out = step_of_stream(i, stream)
+                rewards[i] = out.reward
+                dones[i] = out.done
+                returns[i] = out.info.episode_return
+                steps[i] = out.info.episode_step
+                slab[first_index + i] = out.observation.frame
+                instructions.append(out.observation.instruction)
+            return (rewards, dones, returns, steps,
+                    _maybe_stack(instructions))
+
         while True:
             request = conn.recv()
             kind = request[0]
             try:
                 if kind == _INITIAL:
-                    rewards = np.zeros((k,), np.float32)
-                    dones = np.zeros((k,), bool)
-                    returns = np.zeros((k,), np.float32)
-                    steps = np.zeros((k,), np.int32)
-                    instructions = []
-                    for i, stream in enumerate(streams):
-                        out = stream.initial()
-                        rewards[i] = out.reward
-                        dones[i] = out.done
-                        returns[i] = out.info.episode_return
-                        steps[i] = out.info.episode_step
-                        slab[first_index + i] = out.observation.frame
-                        instructions.append(out.observation.instruction)
-                    conn.send((True, (rewards, dones, returns, steps,
-                                      _maybe_stack(instructions))))
+                    conn.send((True, run_all(
+                        lambda i, stream: stream.initial())))
                 elif kind == _STEP:
                     actions = request[1]
-                    rewards = np.zeros((k,), np.float32)
-                    dones = np.zeros((k,), bool)
-                    returns = np.zeros((k,), np.float32)
-                    steps = np.zeros((k,), np.int32)
-                    instructions = []
-                    for i, stream in enumerate(streams):
-                        out = stream.step(actions[i])
-                        rewards[i] = out.reward
-                        dones[i] = out.done
-                        returns[i] = out.info.episode_return
-                        steps[i] = out.info.episode_step
-                        slab[first_index + i] = out.observation.frame
-                        instructions.append(out.observation.instruction)
-                    conn.send((True, (rewards, dones, returns, steps,
-                                      _maybe_stack(instructions))))
+                    conn.send((True, run_all(
+                        lambda i, stream: stream.step(actions[i]))))
                 elif kind == _CLOSE:
                     break
                 else:

@@ -13,7 +13,9 @@ py_process.py:62-222), re-designed for a host-runtime world:
 
 - Large observation frames travel through a ``multiprocessing.shared_memory``
   block instead of being pickled through the pipe — the pipe carries only
-  scalars and a generation counter.  This is the TPU-feeding optimization:
+  the small fields, and the strict request/response protocol (at most one
+  outstanding step) keeps the single frame slot coherent.  This is the
+  TPU-feeding optimization:
   actor batch assembly memcpys straight out of shared memory into the
   staging buffer.
 
@@ -47,7 +49,8 @@ def _dumps_exception(exc: BaseException) -> bytes:
             f"--- worker traceback ---\n{traceback.format_exc()}"))
 
 
-def _worker_main(conn, make_stream_pickled: bytes, shm_name: Optional[str]):
+def _worker_main(conn, make_stream_pickled: bytes, shm_name: Optional[str],
+                 frame_spec=None):
     """Child process server loop.  (reference: py_process.py:142-177)"""
     stream = None
     shm = None
@@ -62,16 +65,17 @@ def _worker_main(conn, make_stream_pickled: bytes, shm_name: Optional[str]):
             conn.send((False, _dumps_exception(exc)))
             return
 
-        frame_view = None
+        frame_view = (
+            None if shm is None else np.ndarray(
+                frame_spec.shape, frame_spec.dtype, buffer=shm.buf))
 
         def strip_frame(step_output):
             """Move the frame to shared memory (if enabled); lighten the rest."""
-            nonlocal frame_view
             frame = np.asarray(step_output.observation.frame)
             if shm is not None:
-                if frame_view is None:
-                    frame_view = np.ndarray(
-                        frame.shape, frame.dtype, buffer=shm.buf)
+                # The slab view is built from the declared spec; a
+                # mismatched env frame must fail loudly, not corrupt.
+                frame_spec.validate(frame)
                 frame_view[...] = frame
                 return step_output._replace(
                     observation=step_output.observation._replace(frame=None))
@@ -132,6 +136,7 @@ class EnvProcess:
         self._conn = None
         self._shm = None
         self._frame_view = None
+        self._pending = False
 
     def start(self) -> "EnvProcess":
         if self._process is not None:
@@ -147,7 +152,7 @@ class EnvProcess:
         self._process = self._ctx.Process(
             target=_worker_main,
             args=(child_conn, pickle.dumps(self._make_stream),
-                  self._shm.name if self._shm else None),
+                  self._shm.name if self._shm else None, self._frame_spec),
             daemon=True,
         )
         self._process.start()
@@ -194,11 +199,22 @@ class EnvProcess:
         return self._restore_frame(self._roundtrip((_STEP, action)))
 
     def step_send(self, action) -> None:
-        """Async half: dispatch a step without waiting for the result."""
+        """Async half: dispatch a step without waiting for the result.
+
+        At most one step may be outstanding: the shared-memory slot holds
+        exactly one frame, so pipelining two sends would pair step N's
+        reward with step N+1's observation.
+        """
+        if self._pending:
+            raise RuntimeError("step_send while a step is outstanding")
+        self._pending = True
         self._conn.send((_STEP, action))
 
     def step_recv(self):
         """Async half: collect a previously dispatched step."""
+        if not self._pending:
+            raise RuntimeError("step_recv without step_send")
+        self._pending = False
         ok, payload = self._conn.recv()
         if not ok:
             raise pickle.loads(payload)

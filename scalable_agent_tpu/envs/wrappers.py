@@ -46,7 +46,22 @@ def _resize_frame(frame: np.ndarray, height: int, width: int) -> np.ndarray:
         return frame[rows][:, cols]
 
 
-class ResizeWrapper(Wrapper):
+class ObservationWrapper(Wrapper):
+    """Base for wrappers that only rewrite observations: subclasses
+    implement ``_transform`` once and both reset/step stay consistent."""
+
+    def _transform(self, observation):
+        raise NotImplementedError
+
+    def reset(self):
+        return self._transform(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._transform(obs), reward, done, info
+
+
+class ResizeWrapper(ObservationWrapper):
     """Resize frames (optionally grayscale, optionally add channel dim).
 
     (reference: envs/env_wrappers.py:208-267)
@@ -76,13 +91,6 @@ class ResizeWrapper(Wrapper):
         if frame.shape[:2] != (self._height, self._width):
             frame = _resize_frame(frame, self._height, self._width)
         return observation._replace(frame=frame)
-
-    def reset(self):
-        return self._transform(self.env.reset())
-
-    def step(self, action):
-        obs, reward, done, info = self.env.step(action)
-        return self._transform(obs), reward, done, info
 
 
 class FrameStackWrapper(Wrapper):
@@ -152,7 +160,7 @@ class SkipAndStackWrapper(Wrapper):
             SkipFramesWrapper(env, skip_frames), stack_frames))
 
 
-class NormalizeWrapper(Wrapper):
+class NormalizeWrapper(ObservationWrapper):
     """uint8 frames -> float32 in [-1, 1].
 
     (reference: envs/env_wrappers.py:169-205.)  NOTE: the TPU path never
@@ -174,15 +182,8 @@ class NormalizeWrapper(Wrapper):
         frame = observation.frame.astype(np.float32) / 128.0 - 1.0
         return observation._replace(frame=frame)
 
-    def reset(self):
-        return self._transform(self.env.reset())
 
-    def step(self, action):
-        obs, reward, done, info = self.env.step(action)
-        return self._transform(obs), reward, done, info
-
-
-class VerticalCropWrapper(Wrapper):
+class VerticalCropWrapper(ObservationWrapper):
     """Crop frames vertically to a centered band.
 
     (reference: envs/env_wrappers.py:270-290)
@@ -207,13 +208,6 @@ class VerticalCropWrapper(Wrapper):
     def _transform(self, observation):
         frame = observation.frame[self._top:self._top + self._crop_h]
         return observation._replace(frame=frame)
-
-    def reset(self):
-        return self._transform(self.env.reset())
-
-    def step(self, action):
-        obs, reward, done, info = self.env.step(action)
-        return self._transform(obs), reward, done, info
 
 
 class RewardScalingWrapper(Wrapper):
@@ -274,7 +268,7 @@ class TimeLimitWrapper(Wrapper):
         return obs, reward, done, info
 
 
-class PixelFormatWrapper(Wrapper):
+class PixelFormatWrapper(ObservationWrapper):
     """HWC <-> CHW conversion.
 
     (reference: envs/env_wrappers.py:368-420.)  Exists for parity with
@@ -297,13 +291,6 @@ class PixelFormatWrapper(Wrapper):
     def _transform(self, observation):
         return observation._replace(
             frame=np.transpose(observation.frame, (2, 0, 1)))
-
-    def reset(self):
-        return self._transform(self.env.reset())
-
-    def step(self, action):
-        obs, reward, done, info = self.env.step(action)
-        return self._transform(obs), reward, done, info
 
 
 class RecordingWrapper(Wrapper):
@@ -355,7 +342,7 @@ class RecordingWrapper(Wrapper):
         return self.env.close()
 
 
-class RemainingTimeWrapper(Wrapper):
+class RemainingTimeWrapper(ObservationWrapper):
     """Expose normalized remaining time as an extra observation channel.
 
     (reference: envs/env_wrappers.py:337-365 adds a scalar to a Dict obs;
@@ -377,9 +364,8 @@ class RemainingTimeWrapper(Wrapper):
 
     def reset(self):
         self._steps = 0
-        return self._transform(self.env.reset())
+        return super().reset()
 
     def step(self, action):
-        obs, reward, done, info = self.env.step(action)
         self._steps += 1
-        return self._transform(obs), reward, done, info
+        return super().step(action)

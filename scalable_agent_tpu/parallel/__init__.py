@@ -1,0 +1,6 @@
+from scalable_agent_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)

@@ -1,0 +1,63 @@
+"""Device mesh construction and sharding rules.
+
+The reference has NO collectives — its "distribution" is a TF1 gRPC
+parameter-server pattern with a single learner (reference:
+experiment.py:506-512; SURVEY §2.5).  The TPU-native framework replaces
+that with an SPMD mesh:
+
+- axis ``data``: learner data parallelism.  Trajectory batches are sharded
+  over it; gradients are all-reduced over ICI by XLA (the jit partitioner
+  inserts the psum — we only annotate shardings).
+- axis ``model``: tensor parallelism for the network.  Degenerate (=1) for
+  the IMPALA-size net but wired through from day one so larger torsos can
+  shard without interface changes.
+
+Multi-host: the same mesh spans hosts via ``jax.distributed.initialize``;
+data-parallel gradient traffic then rides ICI within a slice and DCN
+across slices, chosen by XLA from the device topology.
+"""
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class MeshSpec(NamedTuple):
+    """Logical mesh shape: data x model."""
+
+    data: int
+    model: int = 1
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 2-axis ('data', 'model') mesh over ``devices``.
+
+    Defaults: all devices on the data axis, model=1.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec(data=len(devices), model=1)
+    if spec.data * spec.model != len(devices):
+        raise ValueError(
+            f"mesh {spec} needs {spec.data * spec.model} devices, "
+            f"got {len(devices)}")
+    array = np.asarray(devices).reshape(spec.data, spec.model)
+    return Mesh(array, axis_names=("data", "model"))
+
+
+def batch_sharding(mesh: Mesh, batch_axis_index: int = 1) -> NamedSharding:
+    """Shard the batch dimension over the data axis.
+
+    Trajectories are time-major [T, B, ...]; B is ``batch_axis_index`` 1.
+    """
+    pspec = [None] * (batch_axis_index + 1)
+    pspec[batch_axis_index] = "data"
+    return NamedSharding(mesh, PartitionSpec(*pspec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (params, optimizer state, scalars)."""
+    return NamedSharding(mesh, PartitionSpec())

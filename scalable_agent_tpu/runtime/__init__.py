@@ -1,0 +1,11 @@
+from scalable_agent_tpu.runtime.actor import ActorPool, VectorActor
+from scalable_agent_tpu.runtime.batcher import (
+    BatcherClosedError,
+    DynamicBatcher,
+)
+from scalable_agent_tpu.runtime.learner import (
+    Learner,
+    LearnerHyperparams,
+    TrainState,
+    Trajectory,
+)

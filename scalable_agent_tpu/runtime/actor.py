@@ -1,0 +1,240 @@
+"""Host-side actor runtime: experience generation feeding the learner.
+
+Re-design of the reference's in-graph actor machinery (reference:
+experiment.py:240-321 ``build_actor`` + QueueRunner threads :559-562) for
+a host-runtime world:
+
+- A ``VectorActor`` drives one vectorized env group: ONE jitted
+  ``actor_step`` evaluates the whole group's policies as a single [B]
+  batch on the TPU (the role of the reference's dynamic batcher — but
+  batching is structural here, not opportunistic; the ``DynamicBatcher``
+  service remains for irregular callers).
+- Trajectory layout matches the reference exactly: each unroll emits T+1
+  entries whose first entry is the last entry of the previous unroll, plus
+  the LSTM state at the unroll boundary (reference: experiment.py:311-321).
+  The learner drops the first behaviour entry and bootstraps from the last
+  (runtime/learner.py).
+- An ``ActorPool`` runs several groups in Python threads; while one group
+  waits on env subprocess pipes, another's inference runs on device (the
+  overlap the reference gets from async TF ops).  Trajectories flow
+  through a bounded queue (capacity 1 per group — the policy-lag semantics
+  of the reference's FIFOQueue(1), experiment.py:531).
+- Weights: actors read a versioned host-side snapshot published by the
+  learner loop (replacing implicit parameter-server variable reads,
+  reference: experiment.py:503-505).
+"""
+
+import queue as queue_lib
+import threading
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step, initial_state
+from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.types import (
+    ActorOutput,
+    AgentOutput,
+    AgentState,
+    map_structure,
+)
+
+
+def _to_numpy(tree):
+    return map_structure(
+        lambda x: None if x is None else np.asarray(x), tree)
+
+
+def _stack_time(entries):
+    """List of [B, ...] pytrees -> one [T, B, ...] pytree."""
+    return map_structure(
+        lambda *xs: None if xs[0] is None else np.stack(xs), *entries)
+
+
+class VectorActor:
+    """One env group: batched inference + trajectory accumulation."""
+
+    def __init__(
+        self,
+        agent: ImpalaAgent,
+        envs: MultiEnv,
+        unroll_length: int,
+        level_name: str = "",
+        seed: int = 0,
+        step_fn: Optional[Callable] = None,
+    ):
+        self._agent = agent
+        self._envs = envs
+        self._unroll_length = unroll_length
+        self.level_name = level_name
+        self._rng = jax.random.key(seed)
+        self._step_count = 0
+        # One jitted inference step shared by everything that hands us the
+        # same agent (jit caches on shapes).
+        self._actor_step = step_fn or jax.jit(
+            lambda params, rng, action, env_output, state: actor_step(
+                agent, params, rng, action, env_output, state))
+        self._last_env_output = None
+        self._last_agent_output = None
+        self._core_state = None
+
+    def _bootstrap(self, params):
+        """First-ever unroll: create the initial carried entries.
+
+        The reference initializes persistent state from a zero action and
+        a zero agent output (experiment.py:243-251).
+        """
+        batch = self._envs.num_envs
+        self._last_env_output = self._envs.initial()
+        self._core_state = initial_state(batch, self._agent.core_size)
+        num_actions = self._agent.num_actions
+        self._last_agent_output = AgentOutput(
+            action=np.zeros((batch,), np.int32),
+            policy_logits=np.zeros((batch, num_actions), np.float32),
+            baseline=np.zeros((batch,), np.float32),
+        )
+
+    def run_unroll(self, params) -> ActorOutput:
+        """Generate one [T+1, B] trajectory batch under ``params``."""
+        if self._last_env_output is None:
+            self._bootstrap(params)
+
+        env_entries = [self._last_env_output]
+        agent_entries = [self._last_agent_output]
+        first_state = _to_numpy(
+            AgentState(c=self._core_state.c, h=self._core_state.h))
+
+        env_output = self._last_env_output
+        agent_output = self._last_agent_output
+        core_state = self._core_state
+        for _ in range(self._unroll_length):
+            self._step_count += 1
+            rng = jax.random.fold_in(self._rng, self._step_count)
+            out, core_state = self._actor_step(
+                params, rng, agent_output.action, env_output, core_state)
+            agent_output = _to_numpy(out)
+            # Dispatch env steps, then wait — device work for other groups
+            # can run while this thread blocks on the pipes.
+            self._envs.step_send(agent_output.action)
+            env_output = self._envs.step_recv()
+            env_entries.append(env_output)
+            agent_entries.append(agent_output)
+
+        self._last_env_output = env_output
+        self._last_agent_output = agent_output
+        self._core_state = core_state
+
+        return ActorOutput(
+            level_name=self.level_name,
+            agent_state=first_state,
+            env_outputs=_stack_time(env_entries),
+            agent_outputs=_stack_time(agent_entries),
+        )
+
+    def close(self):
+        self._envs.close()
+
+
+class ActorPool:
+    """N groups of vectorized actors on threads, feeding a bounded queue."""
+
+    def __init__(
+        self,
+        agent: ImpalaAgent,
+        env_groups: Sequence[MultiEnv],
+        unroll_length: int,
+        level_name: str = "",
+        seed: int = 0,
+        queue_capacity: Optional[int] = None,
+        inference_device: Optional[jax.Device] = None,
+    ):
+        # Inference runs on ONE device (by default the first): actor
+        # threads must never launch multi-device SPMD programs — concurrent
+        # SPMD launches from several threads can interleave differently
+        # across devices and deadlock.  set_params therefore re-places the
+        # learner's (mesh-sharded) params as a single-device snapshot — the
+        # explicit versioned weight publication replacing the reference's
+        # parameter-server variable reads (reference: experiment.py:503-505).
+        self._inference_device = inference_device or jax.devices()[0]
+        shared_step = jax.jit(
+            lambda params, rng, action, env_output, state: actor_step(
+                agent, params, rng, action, env_output, state))
+        self._actors = [
+            VectorActor(agent, envs, unroll_length, level_name=level_name,
+                        seed=seed + 1000 * i, step_fn=shared_step)
+            for i, envs in enumerate(env_groups)
+        ]
+        self.queue = queue_lib.Queue(
+            maxsize=queue_capacity or len(env_groups))
+        self._params = None
+        self._params_version = 0
+        self._params_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._errors = []
+
+    # -- weight publication ------------------------------------------------
+
+    def set_params(self, params, version: Optional[int] = None):
+        """Publish a new weight snapshot for subsequent unrolls."""
+        params = jax.device_put(params, self._inference_device)
+        with self._params_lock:
+            self._params = params
+            self._params_version = (
+                version if version is not None else self._params_version + 1)
+
+    def _get_params(self):
+        with self._params_lock:
+            return self._params
+
+    # -- run ---------------------------------------------------------------
+
+    def _actor_loop(self, actor: VectorActor):
+        try:
+            while not self._stop.is_set():
+                params = self._get_params()
+                trajectory = actor.run_unroll(params)
+                while not self._stop.is_set():
+                    try:
+                        self.queue.put(trajectory, timeout=0.1)
+                        break
+                    except queue_lib.Full:
+                        continue
+        except Exception as exc:  # surface in get_trajectory
+            self._errors.append(exc)
+            self.queue.put(exc)
+
+    def start(self):
+        if self._params is None:
+            raise RuntimeError("set_params before start")
+        for actor in self._actors:
+            t = threading.Thread(
+                target=self._actor_loop, args=(actor,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def get_trajectory(self, timeout: Optional[float] = None) -> ActorOutput:
+        item = self.queue.get(timeout=timeout)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        for actor in self._actors:
+            actor.close()
+
+    @property
+    def num_envs(self) -> int:
+        return sum(a._envs.num_envs for a in self._actors)
+
+    def episode_stats(self):
+        """Merged completed-episode (return, length) ring buffers."""
+        stats = []
+        for actor in self._actors:
+            stats.extend(actor._envs.episode_stats)
+        return stats

@@ -1,0 +1,238 @@
+"""Dynamic batching service for actor inference.
+
+Re-design of the reference's C++ TF-op batcher + Python decorator
+(reference: batcher.cc:91-204 state machine; dynamic_batching.py:65-162
+``batch_fn_with_options``) as a host-side service in front of a jitted TPU
+function:
+
+- Callers (actor threads) submit single samples and block on a Future.
+- A consumer thread forms batches under min_batch_size / max_batch_size /
+  timeout_ms semantics: waits for ``min``; a timeout after the *first*
+  pending request flushes a partial batch (reference:
+  dynamic_batching.py:96-98); never exceeds ``max`` per batch
+  (batcher.cc:241-258).
+- Results scatter back row-by-row to each caller's Future; batches are
+  correlated by id, and multiple consumers may complete out of order
+  (reference: batcher.cc:316-327, dynamic_batching_test.py:334-375).
+- ``close()`` cancels all pending and in-flight callers with an error
+  (reference: batcher.cc:393-431).
+
+Differences by design: callers pass *unbatched* pytrees (the reference
+requires a leading batch dim of exactly 1 and validates it,
+batcher.cc:282-285 — an artifact of TF ops; a host API can just take the
+sample).  Padding: if a formed batch is smaller than ``pad_to_sizes``'s
+smallest fit, inputs are padded so the jitted function sees a small, fixed
+set of batch shapes (XLA recompiles per shape; the reference's TF graph
+had the same constraint solved by static shapes,
+dynamic_batching.py:125-128).
+"""
+
+import itertools
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from scalable_agent_tpu.types import map_structure
+
+
+class BatcherClosedError(RuntimeError):
+    """Raised to callers whose requests were cancelled by close()."""
+
+
+class _Request:
+    __slots__ = ("sample", "future")
+
+    def __init__(self, sample):
+        self.sample = sample
+        self.future = Future()
+
+
+class DynamicBatcher:
+    """Batch single-sample calls onto ``compute_fn``.
+
+    ``compute_fn(batched_sample_tree, batch_size) -> batched_result_tree``
+    where every leaf of the input has a leading batch dim and the result's
+    leaves must too.  ``batch_size`` is the *valid* (unpadded) row count.
+
+    Args mirror ``batch_fn_with_options`` (reference:
+    dynamic_batching.py:65-102): minimum_batch_size, maximum_batch_size,
+    timeout_ms.  ``pad_to_sizes`` (ascending) quantizes batch shapes to
+    bound XLA recompilation; None disables padding.
+    """
+
+    def __init__(
+        self,
+        compute_fn: Callable[[Any, int], Any],
+        minimum_batch_size: int = 1,
+        maximum_batch_size: int = 1024,
+        timeout_ms: Optional[float] = 100.0,
+        pad_to_sizes: Optional[Sequence[int]] = None,
+        num_consumers: int = 1,
+    ):
+        if minimum_batch_size > maximum_batch_size:
+            raise ValueError("minimum_batch_size > maximum_batch_size")
+        if pad_to_sizes is not None:
+            pad_to_sizes = sorted(pad_to_sizes)
+            if pad_to_sizes[-1] < maximum_batch_size:
+                raise ValueError(
+                    "largest pad_to_sizes must cover maximum_batch_size")
+        self._compute_fn = compute_fn
+        self._min = minimum_batch_size
+        self._max = maximum_batch_size
+        self._timeout_s = None if timeout_ms is None else timeout_ms / 1000.0
+        self._pad_to_sizes = pad_to_sizes
+
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._pending = deque()
+        self._closed = False
+        self._batch_ids = itertools.count()
+
+        self._consumers = [
+            threading.Thread(target=self._consume_loop, daemon=True,
+                             name=f"batcher-consumer-{i}")
+            for i in range(num_consumers)
+        ]
+        for t in self._consumers:
+            t.start()
+
+    # -- caller side -------------------------------------------------------
+
+    def compute(self, sample):
+        """Submit one sample; block until its result row is ready."""
+        return self.compute_async(sample).result()
+
+    def compute_async(self, sample) -> Future:
+        with self._lock:
+            if self._closed:
+                raise BatcherClosedError("batcher is closed")
+            request = _Request(sample)
+            self._pending.append(request)
+            self._nonempty.notify()
+        return request.future
+
+    # -- consumer side -----------------------------------------------------
+
+    def _take_batch(self):
+        """Block until a batch is ready (min reached, or timeout after the
+        first pending request), honoring max.  Returns None at close."""
+        with self._lock:
+            deadline = None
+            while True:
+                if self._closed:
+                    return None
+                if len(self._pending) >= self._min:
+                    n = min(len(self._pending), self._max)
+                    return [self._pending.popleft() for _ in range(n)]
+                if not self._pending:
+                    deadline = None
+                    self._nonempty.wait()
+                elif self._timeout_s is None:
+                    self._nonempty.wait()
+                else:
+                    if deadline is None:
+                        deadline = self._now() + self._timeout_s
+                    remaining = deadline - self._now()
+                    if remaining <= 0:  # flush a partial batch
+                        n = min(len(self._pending), self._max)
+                        return [self._pending.popleft() for _ in range(n)]
+                    self._nonempty.wait(remaining)
+
+    @staticmethod
+    def _now():
+        import time
+
+        return time.monotonic()
+
+    def _consume_loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    def _pad_rows(self, n: int) -> int:
+        if self._pad_to_sizes is None:
+            return n
+        for size in self._pad_to_sizes:
+            if size >= n:
+                return size
+        return n
+
+    def _run_batch(self, batch):
+        n = len(batch)
+        padded = self._pad_rows(n)
+        try:
+            stacked = map_structure(
+                lambda *rows: _stack_padded(rows, padded),
+                *[r.sample for r in batch])
+            result = self._compute_fn(stacked, n)
+            rows = _unstack(result, n)
+            for request, row in zip(batch, rows):
+                request.future.set_result(row)
+        except BaseException as exc:  # propagate to all callers in batch
+            for request in batch:
+                if not request.future.done():
+                    request.future.set_exception(exc)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        """Cancel pending requests and stop consumers.
+
+        (reference: batcher.cc:393-431 — close cascades errors to every
+        waiting caller)
+        """
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending)
+            self._pending.clear()
+            self._nonempty.notify_all()
+        for request in pending:
+            request.future.set_exception(
+                BatcherClosedError("batcher closed while request pending"))
+        for t in self._consumers:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+
+
+def _stack_padded(rows, padded: int):
+    arr = np.stack([np.asarray(r) for r in rows])
+    if padded > arr.shape[0]:
+        pad_widths = [(0, padded - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, pad_widths)
+    return arr
+
+
+def _unstack(tree, n: int):
+    """Split a batched result pytree into n per-row pytrees."""
+    leaves, treedef = _flatten(tree)
+    rows = []
+    for i in range(n):
+        rows.append(treedef_unflatten(treedef, [np.asarray(l)[i]
+                                                for l in leaves]))
+    return rows
+
+
+def _flatten(tree):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: x is None)
+    return leaves, treedef
+
+
+def treedef_unflatten(treedef, leaves):
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,74 @@
+"""Checkpoint/resume via Orbax.
+
+Replaces the reference's MonitoredTrainingSession auto-checkpointing
+(reference: experiment.py:608-616 — all global variables incl. the
+env-frame global step, every 600s) and the SF explicit rotation
+(reference: algorithms/utils/agent.py:129-193):
+
+- Saves (params, opt_state, env_frames) on a wall-clock cadence with
+  keep-last-N rotation.
+- env_frames rides in the checkpoint so the frame-keyed LR schedule
+  resumes exactly (SURVEY §5.4).
+- The config JSON snapshot is written separately by Config.save.
+"""
+
+import os
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from scalable_agent_tpu.runtime.learner import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, logdir: str, interval_s: float = 600.0,
+                 keep: int = 5):
+        self._dir = os.path.join(os.path.abspath(logdir), "checkpoints")
+        os.makedirs(self._dir, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True),
+        )
+        self._interval_s = interval_s
+        self._last_save = 0.0
+
+    def maybe_save(self, step: int, state: TrainState,
+                   force: bool = False) -> bool:
+        """Save if the cadence interval elapsed.  ``step`` = update index."""
+        now = time.monotonic()
+        if not force and now - self._last_save < self._interval_s:
+            return False
+        host_state = jax.tree_util.tree_map(np.asarray, state)
+        self._manager.save(step, args=ocp.args.StandardSave(host_state))
+        self._last_save = now
+        return True
+
+    def restore(self, target: Optional[Any] = None
+                ) -> Optional[Tuple[int, Any]]:
+        """Latest (step, host-side TrainState pytree), or None.
+
+        ``target``: a structure-matching pytree (e.g. a freshly initialized
+        TrainState) — required to restore custom NamedTuple nodes like
+        optax optimizer states with their original types.
+        """
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        if target is None:
+            restored = self._manager.restore(step)
+        else:
+            host_target = jax.tree_util.tree_map(np.asarray, target)
+            restored = self._manager.restore(
+                step, args=ocp.args.StandardRestore(host_target))
+        return step, restored
+
+    def wait(self):
+        self._manager.wait_until_finished()
+
+    def close(self):
+        self._manager.wait_until_finished()
+        self._manager.close()

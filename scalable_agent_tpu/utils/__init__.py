@@ -1,0 +1,3 @@
+from scalable_agent_tpu.utils.misc import AttrDict, log
+from scalable_agent_tpu.utils.timing import AvgTime, Timing
+from scalable_agent_tpu.utils.decay import LinearDecay

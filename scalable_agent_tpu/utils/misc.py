@@ -1,0 +1,50 @@
+"""Small shared utilities.
+
+(reference: utils/utils.py — colorlog logger :13-37, AttrDict :42-49; the
+logger here is stdlib-only since colorlog isn't a baked dependency)
+"""
+
+import logging
+import os
+import sys
+
+
+def _make_logger() -> logging.Logger:
+    logger = logging.getLogger("scalable_agent_tpu")
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s][%(process)05d] %(levelname)s %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("SA_TPU_LOGLEVEL", "INFO"))
+        logger.propagate = False
+    return logger
+
+
+log = _make_logger()
+
+
+class AttrDict(dict):
+    """dict with attribute access.  (reference: utils/utils.py:42-49)"""
+
+    __setattr__ = dict.__setitem__
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError as exc:
+            raise AttributeError(key) from exc
+
+
+def memory_consumption_mb() -> float:
+    """Resident set size of this process in MB.
+
+    (reference: utils/utils.py:139-142)
+    """
+    try:
+        import psutil
+
+        return psutil.Process().memory_info().rss / (1024 * 1024)
+    except ImportError:
+        return 0.0

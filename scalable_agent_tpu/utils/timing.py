@@ -1,0 +1,68 @@
+"""Context-manager timers.  (reference: utils/timing.py:8-64)"""
+
+import time
+from collections import deque
+
+
+class AvgTime:
+    """Moving average over the last ``num_values`` measurements."""
+
+    def __init__(self, num_values: int = 50):
+        self.values = deque(maxlen=num_values)
+
+    def add(self, value: float):
+        self.values.append(value)
+
+    @property
+    def value(self) -> float:
+        return sum(self.values) / len(self.values) if self.values else 0.0
+
+    def __str__(self):
+        return f"{self.value:.4f}s (avg of {len(self.values)})"
+
+
+class _TimingContext:
+    def __init__(self, timing, key: str, mode: str):
+        self._timing = timing
+        self._key = key
+        self._mode = mode
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc_info):
+        elapsed = time.monotonic() - self._start
+        t = self._timing
+        if self._mode == "overwrite":
+            t[self._key] = elapsed
+        elif self._mode == "add":
+            t[self._key] = t.get(self._key, 0.0) + elapsed
+        else:  # avg
+            entry = t.get(self._key)
+            if not isinstance(entry, AvgTime):
+                entry = AvgTime()
+                t[self._key] = entry
+            entry.add(elapsed)
+
+
+class Timing(dict):
+    """``with timing.timeit('x'):`` records elapsed seconds under 'x'."""
+
+    def timeit(self, key: str):
+        return _TimingContext(self, key, "overwrite")
+
+    def add_time(self, key: str):
+        return _TimingContext(self, key, "add")
+
+    def time_avg(self, key: str):
+        return _TimingContext(self, key, "avg")
+
+    def __str__(self):
+        parts = []
+        for key, value in self.items():
+            if isinstance(value, AvgTime):
+                parts.append(f"{key}: {value}")
+            else:
+                parts.append(f"{key}: {value:.4f}s")
+        return ", ".join(parts)
