@@ -1,0 +1,1 @@
+from scalable_agent_tpu.native.build import load_library

@@ -1,0 +1,77 @@
+"""On-demand build of the native batcher library.
+
+The reference builds its op with a bare g++ line in the Dockerfile
+(reference: Dockerfile:68-70).  Here the library is dependency-free C++17,
+compiled once into a cache next to the source and reloaded while the
+source hash matches.  Sanitizer variants (the reference relies on Clang
+thread-safety *annotations* only, batcher.cc:182-204; we can actually run
+TSan/ASan) build with ``variant='tsan'|'asan'``.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "batcher.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "_build")
+_LOCK = threading.Lock()
+_CACHE = {}
+
+_VARIANT_FLAGS = {
+    "opt": ["-O2"],
+    "tsan": ["-O1", "-g", "-fsanitize=thread"],
+    "asan": ["-O1", "-g", "-fsanitize=address"],
+}
+
+
+def library_path(variant: str = "opt") -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_BUILD_DIR, f"libbatcher_{variant}_{digest}.so")
+
+
+def build_library(variant: str = "opt") -> str:
+    """Compile (if needed) and return the shared-library path."""
+    path = library_path(variant)
+    if os.path.exists(path):
+        return path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = (["g++", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+           + _VARIANT_FLAGS[variant] + [_SRC, "-o", path + ".tmp"])
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as exc:
+        raise RuntimeError(
+            f"native batcher build failed:\n{exc.stderr}") from exc
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def load_library(variant: str = "opt") -> ctypes.CDLL:
+    with _LOCK:
+        if variant not in _CACHE:
+            lib = ctypes.CDLL(build_library(variant))
+            lib.batcher_create.restype = ctypes.c_void_p
+            lib.batcher_create.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_double]
+            lib.batcher_compute.restype = ctypes.c_int
+            lib.batcher_compute.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            lib.batcher_get_batch.restype = ctypes.c_int
+            lib.batcher_get_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.batcher_set_results.restype = ctypes.c_int
+            lib.batcher_set_results.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int]
+            lib.batcher_close.restype = None
+            lib.batcher_close.argtypes = [ctypes.c_void_p]
+            lib.batcher_destroy.restype = None
+            lib.batcher_destroy.argtypes = [ctypes.c_void_p]
+            _CACHE[variant] = lib
+        return _CACHE[variant]

@@ -1,0 +1,224 @@
+"""ctypes front-end for the native (C++) dynamic batcher.
+
+Same service contract as ``runtime.batcher.DynamicBatcher`` — min/max/
+timeout batch formation, error cascade on close, out-of-order batches —
+but caller blocking, batch formation, and gather/scatter memcpy happen in
+``native/batcher.cc`` with the GIL released (reference: batcher.cc's role
+as the C++ half of dynamic_batching.py).
+
+Samples/results are fixed-layout pytrees of numpy arrays: the layout is
+declared up front (from example pytrees) so every request packs into one
+contiguous byte blob.  The Python consumer thread drives the jitted
+compute function exactly as the QueueRunner thread drives the batched
+subgraph in the reference (dynamic_batching.py:131-144).
+"""
+
+import ctypes
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.native import load_library
+from scalable_agent_tpu.runtime.batcher import BatcherClosedError
+from scalable_agent_tpu.types import map_structure
+
+_OK, _CLOSED, _TIMEOUT, _INVALID = 0, 1, 2, 3
+
+
+class _Layout:
+    """Flattened pytree layout: per-leaf (offset, shape, dtype)."""
+
+    def __init__(self, example):
+        import jax
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(
+            example, is_leaf=lambda x: x is None)
+        self.fields: List[Tuple[int, Tuple[int, ...], np.dtype]] = []
+        offset = 0
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            self.fields.append((offset, arr.shape, arr.dtype))
+            offset += arr.nbytes
+        self.nbytes = offset
+
+    def pack_into(self, buf: memoryview, tree) -> None:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
+        for (offset, shape, dtype), leaf in zip(self.fields, leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype))
+            if arr.shape != shape:
+                raise ValueError(
+                    f"leaf shape {arr.shape} != declared {shape}")
+            buf[offset:offset + arr.nbytes] = arr.tobytes()
+
+    def unpack_rows(self, buf: memoryview, n: int):
+        """[n, nbytes] packed rows -> pytree of [n, ...] arrays."""
+        import jax
+
+        flat = np.frombuffer(buf, np.uint8,
+                             count=n * self.nbytes).reshape(n, self.nbytes)
+        leaves = []
+        for offset, shape, dtype in self.fields:
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            chunk = np.ascontiguousarray(flat[:, offset:offset + nbytes])
+            leaves.append(chunk.view(dtype).reshape((n,) + shape))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def pack_rows(self, buf: memoryview, tree, n: int) -> None:
+        """pytree of [>=n, ...] arrays -> [n, nbytes] packed rows."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
+        flat = np.frombuffer(buf, np.uint8,
+                             count=n * self.nbytes).reshape(n, self.nbytes)
+        # frombuffer on a writable memoryview yields a writable view.
+        for (offset, shape, dtype), leaf in zip(self.fields, leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype)[:n])
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            flat[:, offset:offset + nbytes] = arr.reshape(n, nbytes).view(
+                np.uint8)
+
+    def unpack_one(self, buf: memoryview):
+        import jax
+
+        leaves = []
+        for offset, shape, dtype in self.fields:
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            arr = np.frombuffer(buf, np.uint8, count=nbytes,
+                                offset=offset).view(dtype).reshape(shape)
+            leaves.append(arr.copy())
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class NativeBatcher:
+    """Drop-in DynamicBatcher with the C++ core.
+
+    ``example_sample``/``example_result``: pytrees fixing the layout.
+    ``compute_fn(batched_tree, n) -> batched_result_tree``.
+    """
+
+    def __init__(
+        self,
+        compute_fn: Callable[[Any, int], Any],
+        example_sample,
+        example_result,
+        minimum_batch_size: int = 1,
+        maximum_batch_size: int = 1024,
+        timeout_ms: Optional[float] = 100.0,
+        pad_to_sizes: Optional[Sequence[int]] = None,
+        num_consumers: int = 1,
+    ):
+        if minimum_batch_size > maximum_batch_size:
+            raise ValueError("minimum_batch_size > maximum_batch_size")
+        if pad_to_sizes is not None:
+            pad_to_sizes = sorted(pad_to_sizes)
+            if pad_to_sizes[-1] < maximum_batch_size:
+                raise ValueError(
+                    "largest pad_to_sizes must cover maximum_batch_size")
+        self._lib = load_library()
+        self._compute_fn = compute_fn
+        self._sample_layout = _Layout(example_sample)
+        self._result_layout = _Layout(example_result)
+        self._max = maximum_batch_size
+        self._pad_to_sizes = pad_to_sizes
+        self._handle = ctypes.c_void_p(self._lib.batcher_create(
+            self._sample_layout.nbytes, self._result_layout.nbytes,
+            minimum_batch_size, maximum_batch_size,
+            -1.0 if timeout_ms is None else float(timeout_ms)))
+        self._closed = False
+        self._compute_error = None
+        self._consumers = [
+            threading.Thread(target=self._consume_loop, daemon=True,
+                             name=f"native-batcher-consumer-{i}")
+            for i in range(num_consumers)
+        ]
+        for t in self._consumers:
+            t.start()
+
+    # -- caller side -------------------------------------------------------
+
+    def compute(self, sample):
+        if self._closed:
+            raise BatcherClosedError("batcher is closed")
+        sample_buf = bytearray(self._sample_layout.nbytes)
+        self._sample_layout.pack_into(memoryview(sample_buf), sample)
+        result_buf = bytearray(self._result_layout.nbytes)
+        sample_c = (ctypes.c_char * len(sample_buf)).from_buffer(sample_buf)
+        result_c = (ctypes.c_char * len(result_buf)).from_buffer(result_buf)
+        status = self._lib.batcher_compute(
+            self._handle, ctypes.addressof(sample_c),
+            ctypes.addressof(result_c))
+        if status == _CLOSED:
+            raise BatcherClosedError(
+                "batcher closed while request pending")
+        if status != _OK:
+            error = self._compute_error or RuntimeError(
+                f"native batcher error status {status}")
+            raise error
+        return self._result_layout.unpack_one(memoryview(result_buf))
+
+    # -- consumer side -----------------------------------------------------
+
+    def _pad_rows(self, n: int) -> int:
+        if self._pad_to_sizes is None:
+            return n
+        for size in self._pad_to_sizes:
+            if size >= n:
+                return size
+        return n
+
+    def _consume_loop(self):
+        sample_nbytes = self._sample_layout.nbytes
+        batch_buf = bytearray(self._max * sample_nbytes)
+        batch_c = (ctypes.c_char * len(batch_buf)).from_buffer(batch_buf)
+        n_c = ctypes.c_int(0)
+        id_c = ctypes.c_int64(0)
+        while True:
+            status = self._lib.batcher_get_batch(
+                self._handle, ctypes.addressof(batch_c),
+                ctypes.byref(n_c), ctypes.byref(id_c))
+            if status == _CLOSED:
+                return
+            n = n_c.value
+            try:
+                batched = self._sample_layout.unpack_rows(
+                    memoryview(batch_buf), n)
+                padded = self._pad_rows(n)
+                if padded > n:
+                    batched = map_structure(
+                        lambda x: np.pad(
+                            x, [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)),
+                        batched)
+                result = self._compute_fn(batched, n)
+                result_buf = bytearray(n * self._result_layout.nbytes)
+                self._result_layout.pack_rows(
+                    memoryview(result_buf), result, n)
+                result_c = (ctypes.c_char * len(result_buf)).from_buffer(
+                    result_buf)
+                self._lib.batcher_set_results(
+                    self._handle, id_c.value, ctypes.addressof(result_c),
+                    _OK)
+            except BaseException as exc:
+                self._compute_error = exc
+                self._lib.batcher_set_results(
+                    self._handle, id_c.value, None, _INVALID)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.batcher_close(self._handle)
+        for t in self._consumers:
+            t.join(timeout=5)
+        self._lib.batcher_destroy(self._handle)
+        self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
