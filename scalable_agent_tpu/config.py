@@ -55,7 +55,9 @@ class Config:
     torso_type: str = "shallow"  # shallow | resnet
     compute_dtype: str = "bfloat16"  # conv compute dtype on TPU
     use_instruction: bool = False
-    num_actor_groups: int = 2  # groups alternate env-sim vs TPU inference
+    # (the actor-group count is derived: num_actors // batch_size — each
+    # group is one learner batch; >= 2 groups overlap env-sim with TPU
+    # inference.  See driver.make_env_groups.)
     mesh_data: int = 0  # 0 = all devices
     mesh_model: int = 1
     scan_impl: str = "associative"  # vtrace scan: associative | sequential

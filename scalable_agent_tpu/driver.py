@@ -229,7 +229,11 @@ def train(config: Config) -> Dict[str, float]:
     timing = Timing()
     updates = start_updates
     frames_per_update = config.frames_per_update()
-    frames = updates * frames_per_update
+    # The restored TrainState's env_frames (which drives the LR schedule)
+    # is authoritative — recomputing updates*frames_per_update from the
+    # CURRENT config would silently disagree if batch_size/unroll_length/
+    # num_action_repeats changed between runs.
+    frames = float(np.asarray(state.env_frames))
     last_log = time.monotonic()
     frames_at_last_log = frames
     metrics = {}
@@ -243,7 +247,7 @@ def train(config: Config) -> Dict[str, float]:
                 state, metrics = learner.update(state, traj)
             pool.set_params(state.params, version=updates)
             updates += 1
-            frames = updates * frames_per_update
+            frames += frames_per_update
 
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:

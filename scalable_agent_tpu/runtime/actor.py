@@ -29,6 +29,7 @@ import threading
 from typing import Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step, initial_state
@@ -177,8 +178,16 @@ class ActorPool:
     # -- weight publication ------------------------------------------------
 
     def set_params(self, params, version: Optional[int] = None):
-        """Publish a new weight snapshot for subsequent unrolls."""
+        """Publish a new weight snapshot for subsequent unrolls.
+
+        The snapshot must be a real COPY: when the mesh is a single device,
+        ``device_put`` onto that same device aliases the learner's buffers,
+        and the learner's donated update (donate_argnums) would invalidate
+        the actors' snapshot on the very next step ("Array has been
+        deleted").  ``jnp.copy`` after placement forces fresh buffers.
+        """
         params = jax.device_put(params, self._inference_device)
+        params = jax.tree_util.tree_map(jnp.copy, params)
         with self._params_lock:
             self._params = params
             self._params_version = (

@@ -83,10 +83,22 @@ def _make_optimizer(hp: LearnerHyperparams) -> optax.GradientTransformation:
     # lr=1.0 here; the decayed lr is applied inside the update so it can be
     # keyed on env frames rather than update count (resume-exact, reference
     # experiment.py:409-415).
+    #
+    # initial_scale=1.0: tf.train.RMSPropOptimizer initializes the
+    # mean-square accumulator to ONE (optax defaults to zero), and with
+    # eps=0.1 that difference makes the first updates far larger than the
+    # reference's — early training dynamics would diverge.
+    #
+    # Momentum-ordering note: with rmsprop_momentum != 0, the momentum
+    # trace here accumulates un-lr-scaled steps (the decayed lr multiplies
+    # the final update), whereas TF accumulates lr-scaled steps.  The two
+    # differ only while the lr changes between steps; the reference default
+    # is momentum=0, where both reduce to the same update.
     return optax.rmsprop(
         learning_rate=1.0,
         decay=hp.rmsprop_decay,
         eps=hp.rmsprop_epsilon,
+        initial_scale=1.0,
         momentum=(hp.rmsprop_momentum
                   if hp.rmsprop_momentum else None),
     )

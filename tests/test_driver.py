@@ -70,6 +70,20 @@ class TestDriver:
         np.testing.assert_allclose(returns, expected, rtol=1e-5)
 
 
+@pytest.mark.slow
+class TestSingleDeviceMesh:
+    def test_train_on_one_device_mesh(self, tmp_path):
+        """Regression: with a 1-device mesh the actors' weight snapshot
+        lives on the learner's own device; the learner's donated update
+        must not invalidate it (ActorPool.set_params forces a copy)."""
+        config = small_config(
+            tmp_path, mesh_data=1, num_actors=4,
+            total_environment_frames=160)  # 2 updates of 80 frames
+        metrics = run_train(config)
+        assert metrics["env_frames"] == 160
+        assert np.isfinite(metrics["total_loss"])
+
+
 class TestConfig:
     def test_env_overrides(self):
         config = Config(level_name="atari_breakout")
