@@ -1,29 +1,123 @@
-"""Benchmark: learner env-frames/sec on one chip.
+"""Benchmark: learner env-frames/sec on one chip, plus end-to-end fps.
 
-Measures the steady-state jitted IMPALA update (target-policy unroll +
-V-trace + losses + RMSProp) at the reference's production shapes —
+Primary metric — the steady-state jitted IMPALA update (target-policy
+unroll + V-trace + losses + RMSProp) at the reference's production shapes:
 unroll_length=100, batch_size=32, 72x96 uint8 frames, 4 action repeats
-(reference: experiment.py:61-95) — and reports environment frames consumed
-per second per chip (frames counted x action repeats, matching the
+(reference: experiment.py:61-95), reported as environment frames consumed
+per second per chip (agent steps x action repeats, matching the
 reference's global step, experiment.py:417-420).
+
+Secondary metric (in the same JSON line) — end-to-end actor+learner fps on
+``fake_benchmark`` through the real ActorPool path: subprocess env workers
+actually stepping the simulator 4x per agent step, batched TPU inference,
+prefetched sharded updates.
 
 Baseline: 30,000 env-frames/s — the IMPALA paper's single-GPU learner
 throughput on DMLab with the shallow model (arXiv:1802.01561 via
-README.md:85; BASELINE.md north-star "learner env-frames/sec/chip >=
-published single-GPU IMPALA learner throughput per chip").
+README.md:85; BASELINE.md north-star).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Resilience: the TPU tunnel backend can HANG (not error) at init, which in
+round 1 produced no benchmark number at all.  Backend init is therefore
+probed in a SUBPROCESS with a timeout and retries; on failure the bench
+falls back to CPU so a diagnosable partial result is still emitted.  This
+script ALWAYS prints exactly one JSON line
+{"metric", "value", "unit", "vs_baseline", ...diagnostics...} on stdout,
+even when every stage fails.
 """
 
+import functools
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
-
-import numpy as np
+import traceback
 
 BASELINE_FPS = 30000.0
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+# Hard wall-clock ceiling for the whole bench: a watchdog prints the
+# partial JSON line and exits if ANYTHING (main-process backend init,
+# compile, a wedged env worker) hangs — the probe alone can't guarantee
+# the one-line contract because the tunnel can also hang post-probe.
+TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "480"))
+
+# Peak bf16 matmul FLOP/s per chip, by jax device_kind prefix.
+_PEAK_FLOPS = [
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5", 197e12),  # v5e / "TPU v5 lite"
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 46e12),
+]
 
 
-def main():
+def _peak_flops(device_kind: str):
+    for prefix, peak in _PEAK_FLOPS:
+        if device_kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _probe_backend():
+    """Try default (TPU) backend init in a subprocess — a hung tunnel must
+    not hang the bench.  Returns (info_dict | None, error | None)."""
+    code = (
+        "import jax, json; ds = jax.devices(); "
+        "print(json.dumps({'platform': ds[0].platform, "
+        "'kind': ds[0].device_kind, 'n': len(ds)}))"
+    )
+    last_err = None
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            last_err = (f"backend init hung >{PROBE_TIMEOUT_S:.0f}s "
+                        f"(attempt {attempt + 1}/{PROBE_ATTEMPTS})")
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                return json.loads(proc.stdout.strip().splitlines()[-1]), None
+            except json.JSONDecodeError:
+                last_err = f"unparseable probe output: {proc.stdout[-200:]}"
+                continue
+        last_err = (f"probe rc={proc.returncode}: "
+                    f"{(proc.stderr or '').strip()[-500:]}")
+    return None, last_err
+
+
+def _compile_update(learner, state, traj, diag):
+    """AOT-compile the update ONCE; reuse the executable for warm-up and
+    the measurement loop (lower().compile() artifacts don't land in jit's
+    dispatch cache, so calling learner.update afterwards would pay the
+    multi-minute production-shape compile a second time).  Also records
+    XLA cost-analysis FLOPs.  Falls back to the jitted path on error."""
+    t0 = time.perf_counter()
+    try:
+        compiled = learner._update.lower(state, traj).compile()
+    except Exception:
+        diag["errors"].append(
+            "AOT compile failed, using jit path: "
+            + traceback.format_exc(limit=1))
+        return learner.update
+    diag["compile_s"] = round(time.perf_counter() - t0, 2)
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        diag["flops_per_update"] = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        diag["errors"].append(
+            "cost_analysis failed: " + traceback.format_exc(limit=1))
+    return compiled
+
+
+def bench_learner(result, diag):
+    """Steady-state jitted update at production shapes on one chip."""
     import jax
     import jax.numpy as jnp
 
@@ -45,23 +139,202 @@ def main():
     state = learner.init(jax.random.key(0), traj_host)
     traj = learner.put_trajectory(traj_host)
 
-    # Warm up (compile) then measure steady state.
-    state, metrics = learner.update(state, traj)
+    update = _compile_update(learner, state, traj, diag)
+
+    # Warm up, then calibrate iteration count to the backend speed (a
+    # CPU-fallback update at production shapes can take tens of seconds —
+    # the bench must still finish and report).
+    state, metrics = update(state, traj)
     jax.block_until_ready(metrics["total_loss"])
-    iters = 20
+    t0 = time.perf_counter()
+    state, metrics = update(state, traj)
+    jax.block_until_ready(metrics["total_loss"])
+    once = time.perf_counter() - t0
+    iters = max(2, min(30, int(20.0 / max(once, 1e-4))))
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, metrics = learner.update(state, traj)
+        state, metrics = update(state, traj)
     jax.block_until_ready(metrics["total_loss"])
     dt = (time.perf_counter() - t0) / iters
 
     fps = frames_per_update / dt
-    print(json.dumps({
+    result["value"] = round(fps, 1)
+    result["vs_baseline"] = round(fps / BASELINE_FPS, 3)
+    diag["sec_per_update"] = round(dt, 4)
+    diag["bench_iters"] = iters
+    flops = diag.get("flops_per_update")
+    peak = _peak_flops(diag.get("device_kind", ""))
+    if flops and peak:
+        diag["mfu"] = round(flops / dt / peak, 4)
+        diag["model_tflops_per_s"] = round(flops / dt / 1e12, 2)
+
+
+def bench_end_to_end(result, diag, budget_s=60.0):
+    """Actor+learner fps through the real runtime: subprocess env workers
+    (4 real simulator steps per agent step), batched inference, prefetched
+    sharded updates.  (VERDICT r1 asked for this second metric.)"""
+    import queue as queue_lib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalable_agent_tpu.driver import start_prefetch
+    from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+    from scalable_agent_tpu.envs.spec import TensorSpec
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import (
+        ActorPool, Learner, LearnerHyperparams)
+    from __graft_entry__ import _example_trajectory
+
+    unroll_len, batch, height, width = 100, 32, 72, 96
+    num_actions, repeats = 9, 4
+    num_groups, workers_per_group = 2, 8
+    frames_per_update = batch * unroll_len * repeats
+
+    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16)
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    learner = Learner(agent, LearnerHyperparams(), mesh,
+                      frames_per_update=frames_per_update)
+    state = learner.init(
+        jax.random.key(0),
+        _example_trajectory(unroll_len, batch, height, width, num_actions))
+
+    frame_spec = TensorSpec((height, width, 3), np.uint8, "frame")
+    groups = [
+        MultiEnv(
+            [functools.partial(
+                make_impala_stream, "fake_benchmark",
+                seed=g * 1000 + i, num_action_repeats=repeats,
+                height=height, width=width)
+             for i in range(batch)],
+            frame_spec, num_workers=workers_per_group)
+        for g in range(num_groups)
+    ]
+    pool = ActorPool(agent, groups, unroll_len, level_name="fake_benchmark")
+    pool.set_params(state.params)
+    pool.start()
+
+    # The driver's own prefetch stage — the metric measures the REAL
+    # training path, not a bench-local reimplementation.
+    staged = queue_lib.Queue(maxsize=1)
+    stop = threading.Event()
+    thread = start_prefetch(pool, learner, staged, stop)
+    try:
+        # Warm up: 2 updates cover actor_step + update compiles.
+        for _ in range(2):
+            traj = staged.get(timeout=300)
+            if isinstance(traj, Exception):
+                raise traj
+            state, metrics = learner.update(state, traj)
+            pool.set_params(state.params)
+        jax.block_until_ready(metrics["total_loss"])
+        updates = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < budget_s:
+            traj = staged.get(timeout=300)
+            if isinstance(traj, Exception):
+                raise traj
+            state, metrics = learner.update(state, traj)
+            pool.set_params(state.params)
+            updates += 1
+        jax.block_until_ready(metrics["total_loss"])
+        dt = time.perf_counter() - t0
+        diag["e2e_env_frames_per_sec"] = round(
+            updates * frames_per_update / dt, 1)
+        diag["e2e_updates_measured"] = updates
+    finally:
+        stop.set()
+        pool.stop()
+        thread.join(timeout=5)
+
+
+def main():
+    result = {
         "metric": "learner_env_frames_per_sec_per_chip",
-        "value": round(fps, 1),
+        "value": 0.0,
         "unit": "env_frames/s",
-        "vs_baseline": round(fps / BASELINE_FPS, 3),
-    }))
+        "vs_baseline": 0.0,
+    }
+    diag = {"errors": [], "stage": "probe"}
+
+    # Exactly-one-JSON-line contract: both the watchdog and the normal
+    # path funnel through this once-only emitter.
+    emit_lock = threading.Lock()
+    emitted = [False]
+
+    def emit():
+        with emit_lock:
+            if emitted[0]:
+                return
+            emitted[0] = True
+            result.update(diag)
+            print(json.dumps(result), flush=True)
+
+    def watchdog():
+        # Last-resort guarantee: the tunnel can hang in the MAIN process
+        # too (post-probe init, compile).
+        time.sleep(TOTAL_TIMEOUT_S)
+        diag["errors"].append(
+            f"watchdog: bench exceeded {TOTAL_TIMEOUT_S:.0f}s during "
+            f"stage {diag['stage']!r}")
+        emit()
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    info, probe_err = _probe_backend()
+    if info is None:
+        # TPU unavailable: record why, fall back to CPU so the bench still
+        # produces a diagnosable (clearly-labeled) result.
+        diag["errors"].append(f"tpu backend unavailable: {probe_err}")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    diag["stage"] = "backend_init"
+    import jax
+
+    if info is None:
+        # sitecustomize may pin jax_platforms at the config level, which
+        # overrides the env var — force it after import too.
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        devices = jax.devices()
+    except Exception:
+        # The tunnel can also ERROR (not hang) between probe and init —
+        # fall back to CPU rather than die without the JSON line.
+        diag["errors"].append(
+            "backend init failed post-probe: "
+            + traceback.format_exc(limit=1))
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            devices = jax.devices()
+        except Exception:
+            diag["errors"].append(
+                "cpu fallback init failed: "
+                + traceback.format_exc(limit=1))
+            emit()
+            return
+    diag["platform"] = devices[0].platform
+    diag["device_kind"] = devices[0].device_kind
+    diag["n_devices"] = len(devices)
+    diag["jax_version"] = jax.__version__
+
+    diag["stage"] = "bench_learner"
+    try:
+        bench_learner(result, diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_learner failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_end_to_end"
+    try:
+        bench_end_to_end(
+            result, diag,
+            budget_s=60.0 if diag["platform"] != "cpu" else 15.0)
+    except Exception:
+        diag["errors"].append(
+            "bench_end_to_end failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "done"
+    emit()
 
 
 if __name__ == "__main__":

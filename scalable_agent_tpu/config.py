@@ -60,6 +60,10 @@ class Config:
     # inference.  See driver.make_env_groups.)
     mesh_data: int = 0  # 0 = all devices
     mesh_model: int = 1
+    # Actor inference: "structural" (one jitted step per group) or
+    # "service" (C++ dynamic batcher co-batches groups into one call —
+    # the reference's architecture, dynamic_batching.py + batcher.cc).
+    inference_mode: str = "structural"
     scan_impl: str = "associative"  # vtrace scan: associative | sequential
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5

@@ -127,6 +127,7 @@ def make_env_groups(config: Config) -> List[MultiEnv]:
                 make_impala_stream, config.level_name,
                 seed=config.seed * 100000 + g * 1000 + i,
                 benchmark_mode=config.benchmark_mode,
+                num_action_repeats=config.num_action_repeats,
                 **env_kwargs(config))
             for i in range(group_size)
         ]
@@ -142,6 +143,35 @@ def to_trajectory(actor_output) -> Trajectory:
         env_outputs=actor_output.env_outputs,
         agent_outputs=actor_output.agent_outputs,
     )
+
+
+def start_prefetch(pool, learner, staged: queue_lib.Queue,
+                   stop: threading.Event) -> threading.Thread:
+    """Start the device-prefetch stage: pulls ActorPool trajectories,
+    places them sharded on device, and stages them one deep — the
+    reference's StagingArea +1-step policy lag (experiment.py:587-597).
+    Exceptions surface through the staged queue."""
+
+    def prefetch_loop():
+        try:
+            while not stop.is_set():
+                try:
+                    out = pool.get_trajectory(timeout=0.5)
+                except queue_lib.Empty:
+                    continue
+                traj = learner.put_trajectory(to_trajectory(out))
+                while not stop.is_set():
+                    try:
+                        staged.put(traj, timeout=0.5)
+                        break
+                    except queue_lib.Full:
+                        continue
+        except Exception as exc:  # surface in the consumer loop
+            staged.put(exc)
+
+    thread = threading.Thread(target=prefetch_loop, daemon=True)
+    thread.start()
+    return thread
 
 
 def train(config: Config) -> Dict[str, float]:
@@ -195,7 +225,8 @@ def train(config: Config) -> Dict[str, float]:
 
     env_groups = make_env_groups(config)
     pool = ActorPool(agent, env_groups, config.unroll_length,
-                     level_name=config.level_name, seed=config.seed)
+                     level_name=config.level_name, seed=config.seed,
+                     inference_mode=config.inference_mode)
     pool.set_params(state.params)
     pool.start()
 
@@ -204,26 +235,7 @@ def train(config: Config) -> Dict[str, float]:
     # experiment.py:587-597).
     staged: queue_lib.Queue = queue_lib.Queue(maxsize=1)
     prefetch_stop = threading.Event()
-
-    def prefetch_loop():
-        try:
-            while not prefetch_stop.is_set():
-                try:
-                    out = pool.get_trajectory(timeout=0.5)
-                except queue_lib.Empty:
-                    continue
-                traj = learner.put_trajectory(to_trajectory(out))
-                while not prefetch_stop.is_set():
-                    try:
-                        staged.put(traj, timeout=0.5)
-                        break
-                    except queue_lib.Full:
-                        continue
-        except Exception as exc:  # surface in the main loop
-            staged.put(exc)
-
-    prefetch_thread = threading.Thread(target=prefetch_loop, daemon=True)
-    prefetch_thread.start()
+    prefetch_thread = start_prefetch(pool, learner, staged, prefetch_stop)
 
     writer = MetricsWriter(config.logdir)
     timing = Timing()
@@ -314,7 +326,8 @@ def test(config: Config) -> Dict[str, List[float]]:
 
     level_returns: Dict[str, List[float]] = {config.level_name: []}
     stream = make_impala_stream(
-        config.level_name, seed=config.seed, **env_kwargs(config))
+        config.level_name, seed=config.seed,
+        num_action_repeats=config.num_action_repeats, **env_kwargs(config))
     try:
         output = stream.initial()
         core_state = initial_state(1, agent.core_size)

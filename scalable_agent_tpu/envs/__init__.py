@@ -13,14 +13,31 @@ from scalable_agent_tpu.envs.worker import EnvProcess, RemoteEnvError
 
 
 def make_impala_stream(env_name: str, seed: int = 0,
-                       benchmark_mode: bool = False, **kwargs):
+                       benchmark_mode: bool = False,
+                       num_action_repeats: int = 1, **kwargs):
     """Name -> seeded ImpalaStream; picklable via functools.partial.
 
     The one-stop factory the actor runtime and env workers use
     (the role of create_environment, reference: experiment.py:430-459).
+
+    ``num_action_repeats`` makes each agent step drive the simulator that
+    many times (summed rewards) — the reference applies this natively in
+    its DMLab adapter (``num_steps``, reference: environments.py:111) and
+    via frameskip wrappers elsewhere.  Adapters that already repeat
+    internally (e.g. the Atari skip-4 pipeline, Doom's skip_frames
+    make_action) declare ``native_action_repeats`` and are not
+    double-wrapped.
     """
     env = create_env(env_name, **kwargs)
     env.seed(seed)
+    native = getattr(env, "native_action_repeats", 1)
+    if num_action_repeats > 1 and num_action_repeats != native:
+        if native != 1:
+            raise ValueError(
+                f"{env_name!r} applies {native} native action repeats; "
+                f"cannot also request {num_action_repeats}")
+        from scalable_agent_tpu.envs.wrappers import SkipFramesWrapper
+        env = SkipFramesWrapper(env, num_action_repeats)
     stream = StreamAdapter(env)
     if benchmark_mode:
         stream = BenchmarkStream(stream, seed=seed)

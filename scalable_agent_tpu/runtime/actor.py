@@ -24,6 +24,7 @@ a host-runtime world:
   reference: experiment.py:503-505).
 """
 
+import functools
 import queue as queue_lib
 import threading
 from typing import Callable, Optional, Sequence
@@ -51,6 +52,20 @@ def _stack_time(entries):
     """List of [B, ...] pytrees -> one [T, B, ...] pytree."""
     return map_structure(
         lambda *xs: None if xs[0] is None else np.stack(xs), *entries)
+
+
+def _service_step(agent, params, key_data, actions, env_outputs, states):
+    """k co-batched group requests ([k, B, ...]) -> [k, B, ...] outputs.
+
+    vmapped so each group keeps its own rng stream; params are shared
+    across the vmap (one weight broadcast, k-fold batched compute)."""
+
+    rngs = jax.random.wrap_key_data(key_data)  # [k] typed keys
+
+    def one_group(rng, action, env_output, state):
+        return actor_step(agent, params, rng, action, env_output, state)
+
+    return jax.vmap(one_group)(rngs, actions, env_outputs, states)
 
 
 class VectorActor:
@@ -138,7 +153,21 @@ class VectorActor:
 
 
 class ActorPool:
-    """N groups of vectorized actors on threads, feeding a bounded queue."""
+    """N groups of vectorized actors on threads, feeding a bounded queue.
+
+    Two inference modes:
+
+    - ``structural`` (default): each group evaluates its own jitted
+      ``actor_step`` on its full [B] batch — regular, shape-stable device
+      calls.
+    - ``service``: groups submit their inference requests to a
+      ``NativeBatcher`` (the C++ dynamic-batching core) whose consumer
+      thread co-batches however many groups arrive within ``timeout_ms``
+      into ONE device call (vmapped over groups).  This is the reference's
+      dynamic-batching architecture — many irregular callers amortized
+      onto one accelerator (reference: dynamic_batching.py:65-102 +
+      batcher.cc) — and pays off when there are many small groups.
+    """
 
     def __init__(
         self,
@@ -149,6 +178,8 @@ class ActorPool:
         seed: int = 0,
         queue_capacity: Optional[int] = None,
         inference_device: Optional[jax.Device] = None,
+        inference_mode: str = "structural",
+        service_timeout_ms: float = 5.0,
     ):
         # Inference runs on ONE device (by default the first): actor
         # threads must never launch multi-device SPMD programs — concurrent
@@ -158,12 +189,32 @@ class ActorPool:
         # explicit versioned weight publication replacing the reference's
         # parameter-server variable reads (reference: experiment.py:503-505).
         self._inference_device = inference_device or jax.devices()[0]
-        shared_step = jax.jit(
-            lambda params, rng, action, env_output, state: actor_step(
-                agent, params, rng, action, env_output, state))
+        self._agent = agent
+        if inference_mode == "structural":
+            step_fn = jax.jit(
+                lambda params, rng, action, env_output, state: actor_step(
+                    agent, params, rng, action, env_output, state))
+        elif inference_mode == "service":
+            sizes = {envs.num_envs for envs in env_groups}
+            if len(sizes) > 1:
+                raise ValueError(
+                    f"service inference needs uniform group sizes, got "
+                    f"{sorted(sizes)}")
+            self._service_max = len(env_groups)
+            self._service_timeout_ms = service_timeout_ms
+            self._batcher = None  # built lazily from the first request
+            self._batcher_lock = threading.Lock()
+            # One device call for k co-batched groups: vmap over the group
+            # axis with per-group rng.
+            self._service_jit = jax.jit(functools.partial(
+                _service_step, agent))
+            step_fn = self._service_request
+        else:
+            raise ValueError(f"unknown inference_mode {inference_mode!r}")
+        self._inference_mode = inference_mode
         self._actors = [
             VectorActor(agent, envs, unroll_length, level_name=level_name,
-                        seed=seed + 1000 * i, step_fn=shared_step)
+                        seed=seed + 1000 * i, step_fn=step_fn)
             for i, envs in enumerate(env_groups)
         ]
         self.queue = queue_lib.Queue(
@@ -175,19 +226,81 @@ class ActorPool:
         self._threads = []
         self._errors = []
 
+    # -- service-mode plumbing ---------------------------------------------
+
+    def _service_request(self, params, rng, action, env_output, state):
+        """VectorActor-facing step_fn: one group's request -> the shared
+        batcher (params arg ignored; the consumer reads the newest
+        snapshot at batch time, like the reference's variable reads)."""
+        del params
+        sample = (
+            np.asarray(jax.random.key_data(rng), np.uint32),
+            np.asarray(action),
+            _to_numpy(env_output),
+            np.asarray(state.c),
+            np.asarray(state.h),
+        )
+        batcher = self._ensure_batcher(sample)
+        out, c, h = batcher.compute(sample)
+        return out, AgentState(c=c, h=h)
+
+    def _ensure_batcher(self, example_sample):
+        with self._batcher_lock:
+            if self._batcher is None:
+                from scalable_agent_tpu.runtime.native_batcher import (
+                    NativeBatcher)
+
+                example_result = self._service_compute(
+                    map_structure(
+                        lambda x: None if x is None else x[None],
+                        example_sample), 1)
+                example_result = map_structure(
+                    lambda x: None if x is None else x[0], example_result)
+                pad = [1]
+                while pad[-1] < self._service_max:
+                    pad.append(min(pad[-1] * 2, self._service_max))
+                self._batcher = NativeBatcher(
+                    self._service_compute,
+                    example_sample=example_sample,
+                    example_result=example_result,
+                    minimum_batch_size=1,
+                    maximum_batch_size=self._service_max,
+                    timeout_ms=self._service_timeout_ms,
+                    pad_to_sizes=pad,
+                )
+            return self._batcher
+
+    def _service_compute(self, batched, k):
+        """Batcher consumer: k co-batched group requests -> one vmapped
+        jitted device call under the newest params."""
+        key_data, action, env_output, c, h = batched
+        out, new_state = self._service_jit(
+            self._get_params(), key_data, action, env_output,
+            AgentState(c=c, h=h))
+        out = _to_numpy(out)
+        new_state = _to_numpy(new_state)
+        return (out, new_state.c, new_state.h)
+
     # -- weight publication ------------------------------------------------
 
     def set_params(self, params, version: Optional[int] = None):
         """Publish a new weight snapshot for subsequent unrolls.
 
-        The snapshot must be a real COPY: when the mesh is a single device,
-        ``device_put`` onto that same device aliases the learner's buffers,
-        and the learner's donated update (donate_argnums) would invalidate
-        the actors' snapshot on the very next step ("Array has been
-        deleted").  ``jnp.copy`` after placement forces fresh buffers.
+        The snapshot must be a real COPY when the learner's params already
+        live solely on the inference device (a 1-device mesh): there
+        ``device_put`` aliases the learner's buffers, and the learner's
+        donated update (donate_argnums) would invalidate the actors'
+        snapshot on the very next step ("Array has been deleted").  On a
+        multi-device mesh the resharding device_put materializes fresh
+        buffers by itself, so the extra copy is skipped.
         """
+        may_alias = any(
+            getattr(leaf, "devices", None) is not None
+            and leaf.devices() == {self._inference_device}
+            for leaf in jax.tree_util.tree_leaves(params))
         params = jax.device_put(params, self._inference_device)
-        params = jax.tree_util.tree_map(jnp.copy, params)
+        if may_alias:
+            params = jax.tree_util.tree_map(jnp.copy, params)
         with self._params_lock:
             self._params = params
             self._params_version = (
@@ -211,6 +324,8 @@ class ActorPool:
                     except queue_lib.Full:
                         continue
         except Exception as exc:  # surface in get_trajectory
+            if self._stop.is_set():
+                return  # shutdown cascade (e.g. batcher closed) — benign
             self._errors.append(exc)
             self.queue.put(exc)
 
@@ -232,6 +347,13 @@ class ActorPool:
 
     def stop(self):
         self._stop.set()
+        if self._inference_mode == "service":
+            with self._batcher_lock:
+                if self._batcher is not None:
+                    # Cascades BatcherClosedError to any actor thread
+                    # blocked awaiting a batch (reference: batcher.cc
+                    # close semantics, :393-431).
+                    self._batcher.close()
         for t in self._threads:
             t.join(timeout=10)
         for actor in self._actors:

@@ -34,9 +34,15 @@ class _Layout:
 
         leaves, self.treedef = jax.tree_util.tree_flatten(
             example, is_leaf=lambda x: x is None)
-        self.fields: List[Tuple[int, Tuple[int, ...], np.dtype]] = []
+        # A None leaf (e.g. an absent optional observation) contributes
+        # zero bytes and round-trips as None.
+        self.fields: List[Optional[
+            Tuple[int, Tuple[int, ...], np.dtype]]] = []
         offset = 0
         for leaf in leaves:
+            if leaf is None:
+                self.fields.append(None)
+                continue
             arr = np.asarray(leaf)
             self.fields.append((offset, arr.shape, arr.dtype))
             offset += arr.nbytes
@@ -46,8 +52,13 @@ class _Layout:
         import jax
 
         leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
-        for (offset, shape, dtype), leaf in zip(self.fields, leaves):
-            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype))
+        for field, leaf in zip(self.fields, leaves):
+            if field is None:
+                continue
+            offset, shape, dtype = field
+            # No ascontiguousarray here: it would promote 0-d leaves to
+            # 1-d, and tobytes() already emits C-order bytes.
+            arr = np.asarray(leaf, dtype=dtype)
             if arr.shape != shape:
                 raise ValueError(
                     f"leaf shape {arr.shape} != declared {shape}")
@@ -60,7 +71,11 @@ class _Layout:
         flat = np.frombuffer(buf, np.uint8,
                              count=n * self.nbytes).reshape(n, self.nbytes)
         leaves = []
-        for offset, shape, dtype in self.fields:
+        for field in self.fields:
+            if field is None:
+                leaves.append(None)
+                continue
+            offset, shape, dtype = field
             nbytes = int(np.prod(shape)) * dtype.itemsize
             chunk = np.ascontiguousarray(flat[:, offset:offset + nbytes])
             leaves.append(chunk.view(dtype).reshape((n,) + shape))
@@ -74,17 +89,27 @@ class _Layout:
         flat = np.frombuffer(buf, np.uint8,
                              count=n * self.nbytes).reshape(n, self.nbytes)
         # frombuffer on a writable memoryview yields a writable view.
-        for (offset, shape, dtype), leaf in zip(self.fields, leaves):
+        for field, leaf in zip(self.fields, leaves):
+            if field is None:
+                continue
+            offset, shape, dtype = field
             arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype)[:n])
             nbytes = int(np.prod(shape)) * dtype.itemsize
-            flat[:, offset:offset + nbytes] = arr.reshape(n, nbytes).view(
-                np.uint8)
+            # View as bytes BEFORE reshaping: reshape counts elements, so
+            # reshaping the typed array to byte-count columns blows up for
+            # any leaf with >1 element per row.
+            flat[:, offset:offset + nbytes] = (
+                arr.view(np.uint8).reshape(n, nbytes))
 
     def unpack_one(self, buf: memoryview):
         import jax
 
         leaves = []
-        for offset, shape, dtype in self.fields:
+        for field in self.fields:
+            if field is None:
+                leaves.append(None)
+                continue
+            offset, shape, dtype = field
             nbytes = int(np.prod(shape)) * dtype.itemsize
             arr = np.frombuffer(buf, np.uint8, count=nbytes,
                                 offset=offset).view(dtype).reshape(shape)
@@ -109,6 +134,7 @@ class NativeBatcher:
         timeout_ms: Optional[float] = 100.0,
         pad_to_sizes: Optional[Sequence[int]] = None,
         num_consumers: int = 1,
+        variant: str = "opt",
     ):
         if minimum_batch_size > maximum_batch_size:
             raise ValueError("minimum_batch_size > maximum_batch_size")
@@ -117,7 +143,7 @@ class NativeBatcher:
             if pad_to_sizes[-1] < maximum_batch_size:
                 raise ValueError(
                     "largest pad_to_sizes must cover maximum_batch_size")
-        self._lib = load_library()
+        self._lib = load_library(variant)
         self._compute_fn = compute_fn
         self._sample_layout = _Layout(example_sample)
         self._result_layout = _Layout(example_result)
@@ -188,7 +214,7 @@ class NativeBatcher:
                 padded = self._pad_rows(n)
                 if padded > n:
                     batched = map_structure(
-                        lambda x: np.pad(
+                        lambda x: None if x is None else np.pad(
                             x, [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)),
                         batched)
                 result = self._compute_fn(batched, n)

@@ -101,6 +101,39 @@ class TestStreams:
         np.testing.assert_allclose(
             out.info.episode_return, float(out.reward), rtol=1e-6)
 
+    def test_action_repeats_drive_real_simulator_steps(self):
+        """num_action_repeats must mean actual simulator steps (reference
+        applies repeats natively, environments.py:111) — one agent step
+        advances the underlying env 4 times and sums the 4 rewards."""
+        stream = make_impala_stream(
+            "fake_small", num_action_repeats=4, episode_length=12)
+        stream.initial()
+        out = stream.step(0)
+        # FakeEnv encodes its internal step index in pixel [0, 1, 0].
+        assert out.observation.frame[0, 1, 0] == 4
+        expected_reward = sum(0.1 * (t % 3) for t in (1, 2, 3, 4))
+        np.testing.assert_allclose(out.reward, expected_reward, rtol=1e-6)
+        # Episode of 12 simulator steps ends after 3 agent steps.
+        out = stream.step(0)
+        assert not out.done
+        out = stream.step(0)
+        assert out.done
+        stream.close()
+
+    def test_native_repeats_not_double_wrapped(self):
+        env = small_env()
+        env.native_action_repeats = 4
+        import scalable_agent_tpu.envs.registry as registry
+        registry.register_family("nativerep_", lambda name, **kw: env)
+        try:
+            stream = make_impala_stream("nativerep_x", num_action_repeats=4)
+            stream.initial()
+            out = stream.step(0)
+            # Un-wrapped: a single underlying step.
+            assert out.observation.frame[0, 1, 0] == 1
+        finally:
+            registry._FACTORIES.pop("nativerep_", None)
+
     def test_benchmark_stream_ignores_actions(self):
         mk = lambda: BenchmarkStream(
             StreamAdapter(small_env(seed=1)), seed=5)

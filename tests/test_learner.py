@@ -215,3 +215,44 @@ class TestActorPool:
             assert len(stats) > 0  # fake episodes are 10 steps; T*3 > 10
         finally:
             pool.stop()
+
+    def test_service_mode_co_batched_inference(self, setup):
+        """Dynamic-batching inference: 4 small groups share one vmapped
+        device call through the C++ batcher; trajectories keep the same
+        [T+1, B] contract and the learner consumes them unchanged."""
+        agent, _, _, _, params = setup
+        small = 2  # envs per group — small groups are the service's case
+        mesh = make_mesh(MeshSpec(data=small, model=1),
+                         devices=jax.devices()[:small])
+        groups = [make_envs(small, workers=1) for _ in range(4)]
+        hp = LearnerHyperparams(total_environment_frames=1e6)
+        learner = Learner(agent, hp, mesh, frames_per_update=T * small)
+        pool = ActorPool(agent, groups, unroll_length=T, seed=13,
+                         inference_mode="service", service_timeout_ms=3.0)
+        pool.set_params(params)
+        pool.start()
+        try:
+            state = None
+            for _ in range(4):
+                out = pool.get_trajectory(timeout=120)
+                traj = to_trajectory(out)
+                assert traj.agent_outputs.action.shape == (T + 1, small)
+                if state is None:
+                    state = learner.init(jax.random.key(5), traj)
+                state, metrics = learner.update(
+                    state, learner.put_trajectory(traj))
+                pool.set_params(state.params)
+            assert np.isfinite(float(metrics["total_loss"]))
+        finally:
+            pool.stop()
+
+    def test_service_mode_rejects_ragged_groups(self, setup):
+        agent, _, _, _, _ = setup
+        groups = [make_envs(2, workers=1), make_envs(3, workers=1)]
+        try:
+            with pytest.raises(ValueError, match="uniform group sizes"):
+                ActorPool(agent, groups, unroll_length=T,
+                          inference_mode="service")
+        finally:
+            for g in groups:
+                g.close()
