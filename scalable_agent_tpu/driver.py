@@ -55,9 +55,12 @@ from scalable_agent_tpu.utils import Timing, log
 def env_kwargs(config: Config) -> dict:
     """Per-family constructor kwargs (the reference threads width/height/
     etc. through create_environment, experiment.py:430-459)."""
-    if config.level_name.startswith("fake_"):
+    name = config.level_name
+    if name.startswith(("fake_", "dmlab_")):
         return {"height": config.height, "width": config.width,
                 "with_instruction": config.use_instruction}
+    if name.startswith(("atari_", "gym_")):
+        return {"height": config.height, "width": config.width}
     return {}
 
 
@@ -113,13 +116,16 @@ def zero_trajectory(config: Config, observation_spec, num_actions: int,
     )
 
 
-def make_env_groups(config: Config) -> List[MultiEnv]:
+def make_env_groups(config: Config, frame_spec: TensorSpec
+                    ) -> List[MultiEnv]:
     """num_actors envs as groups of batch_size (each group = one learner
-    batch; >= 2 groups so env simulation and TPU inference overlap)."""
+    batch; >= 2 groups so env simulation and TPU inference overlap).
+
+    ``frame_spec`` is the PROBED post-wrapper spec — pipelines change the
+    channel count (e.g. Atari's grayscale stack-4 emits [84, 84, 4]), so
+    the shared-memory slab layout cannot be assumed 3-channel."""
     group_size = config.group_size()
     num_groups = max(1, config.num_actors // group_size)
-    frame_spec = TensorSpec(
-        (config.height, config.width, 3), np.uint8, "frame")
     groups = []
     for g in range(num_groups):
         fns = [
@@ -223,7 +229,7 @@ def train(config: Config) -> Dict[str, float]:
     else:
         start_updates = 0
 
-    env_groups = make_env_groups(config)
+    env_groups = make_env_groups(config, observation_spec.frame)
     pool = ActorPool(agent, env_groups, config.unroll_length,
                      level_name=config.level_name, seed=config.seed,
                      inference_mode=config.inference_mode)

@@ -6,7 +6,11 @@ from scalable_agent_tpu.envs.core import (
     Wrapper,
 )
 from scalable_agent_tpu.envs.fake import FakeEnv
-from scalable_agent_tpu.envs.registry import create_env, register_family
+from scalable_agent_tpu.envs.registry import (
+    create_env,
+    family_consumes_repeats,
+    register_family,
+)
 from scalable_agent_tpu.envs.spec import TensorSpec, spec_of
 from scalable_agent_tpu.envs.vector import MultiEnv
 from scalable_agent_tpu.envs.worker import EnvProcess, RemoteEnvError
@@ -28,6 +32,8 @@ def make_impala_stream(env_name: str, seed: int = 0,
     make_action) declare ``native_action_repeats`` and are not
     double-wrapped.
     """
+    if family_consumes_repeats(env_name):
+        kwargs["num_action_repeats"] = num_action_repeats
     env = create_env(env_name, **kwargs)
     env.seed(seed)
     native = getattr(env, "native_action_repeats", 1)

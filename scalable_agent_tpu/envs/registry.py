@@ -6,16 +6,38 @@ themselves; heavyweight simulator families are imported lazily so a missing
 pip package only fails when that family is actually requested.
 """
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from scalable_agent_tpu.envs.core import Environment
 
-_FACTORIES: Dict[str, Callable[..., Environment]] = {}
+_FACTORIES: Dict[str, Tuple[Callable[..., Environment], bool]] = {}
 
 
-def register_family(prefix: str, factory: Callable[..., Environment]):
-    """Register ``factory(full_name, **kwargs)`` for env names ``prefix*``."""
-    _FACTORIES[prefix] = factory
+def register_family(prefix: str, factory: Callable[..., Environment],
+                    consumes_action_repeats: bool = False):
+    """Register ``factory(full_name, **kwargs)`` for env names ``prefix*``.
+
+    ``consumes_action_repeats``: the family applies action repeats
+    natively (simulator-side, like DMLab's ``num_steps`` or Atari's
+    skip pipeline) and accepts a ``num_action_repeats`` kwarg.  Families
+    without it are wrapped by ``make_impala_stream`` instead and never
+    see the kwarg — so third-party factories need no boilerplate.
+    """
+    _FACTORIES[prefix] = (factory, consumes_action_repeats)
+
+
+def _lookup(full_env_name: str):
+    for prefix, entry in sorted(
+            _FACTORIES.items(), key=lambda kv: -len(kv[0])):
+        if full_env_name.startswith(prefix):
+            return entry
+    raise ValueError(
+        f"unknown env name {full_env_name!r}; registered prefixes: "
+        f"{sorted(_FACTORIES)}")
+
+
+def family_consumes_repeats(full_env_name: str) -> bool:
+    return _lookup(full_env_name)[1]
 
 
 def create_env(full_env_name: str, **kwargs) -> Environment:
@@ -23,13 +45,7 @@ def create_env(full_env_name: str, **kwargs) -> Environment:
 
     (reference: envs/create_env.py:1-19)
     """
-    for prefix, factory in sorted(
-            _FACTORIES.items(), key=lambda kv: -len(kv[0])):
-        if full_env_name.startswith(prefix):
-            return factory(full_env_name, **kwargs)
-    raise ValueError(
-        f"unknown env name {full_env_name!r}; registered prefixes: "
-        f"{sorted(_FACTORIES)}")
+    return _lookup(full_env_name)[0](full_env_name, **kwargs)
 
 
 def _make_fake(full_env_name: str, **kwargs) -> Environment:
@@ -73,9 +89,12 @@ _make_atari = _lazy_family(
     "atari_", "scalable_agent_tpu.envs.atari", "make_atari_env")
 _make_dmlab = _lazy_family(
     "dmlab_", "scalable_agent_tpu.envs.dmlab", "make_dmlab_env")
+_make_gym = _lazy_family(
+    "gym_", "scalable_agent_tpu.envs.gym_adapter", "make_gym_env")
 
 
 register_family("fake_", _make_fake)
-register_family("doom_", _make_doom)
-register_family("atari_", _make_atari)
-register_family("dmlab_", _make_dmlab)
+register_family("doom_", _make_doom, consumes_action_repeats=True)
+register_family("atari_", _make_atari, consumes_action_repeats=True)
+register_family("dmlab_", _make_dmlab, consumes_action_repeats=True)
+register_family("gym_", _make_gym)

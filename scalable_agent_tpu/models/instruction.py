@@ -12,41 +12,22 @@ Strings cannot exist on a TPU, so the TPU-native design splits the work:
   (reference: experiment.py:142-146).
 """
 
-import zlib
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-NUM_HASH_BUCKETS = 1000  # reference: experiment.py:131
+# Host-side hashing lives in utils.text (numpy-only, importable by env
+# workers that must never pull in jax); re-exported here for the device
+# side of the pipeline.
+from scalable_agent_tpu.utils.text import (  # noqa: F401
+    MAX_INSTRUCTION_LEN,
+    NUM_HASH_BUCKETS,
+    hash_instruction,
+)
+
 EMBEDDING_SIZE = 20  # reference: experiment.py:135
 LSTM_SIZE = 64  # reference: experiment.py:142
-MAX_INSTRUCTION_LEN = 16
-
-
-def hash_instruction(
-    instruction: str,
-    max_len: int = MAX_INSTRUCTION_LEN,
-    num_buckets: int = NUM_HASH_BUCKETS,
-) -> np.ndarray:
-    """Host-side: whitespace-split and hash words to 1-based bucket ids.
-
-    Returns int32 [max_len]; 0 is padding.  Bucket ids are 1..num_buckets so
-    that "no token" is distinguishable from any real token.  Uses crc32 — a
-    stable, python-version-independent hash (the reference's in-graph
-    fingerprint hash has the same "small risk of collisions" caveat,
-    reference: experiment.py:129-132).
-
-    Instructions longer than ``max_len`` words are truncated — a deliberate
-    divergence from the reference's unbounded dynamic_rnn: TPU/XLA needs
-    static shapes, and DMLab instructions are short ("go to the red door");
-    raise ``max_len`` if a level family needs more.
-    """
-    ids = np.zeros([max_len], dtype=np.int32)
-    for i, word in enumerate(instruction.split()[:max_len]):
-        ids[i] = 1 + zlib.crc32(word.encode("utf-8")) % num_buckets
-    return ids
 
 
 class _MaskedLSTMStep(nn.Module):

@@ -1,0 +1,38 @@
+"""Host-side text hashing for language instructions (numpy-only).
+
+Lives under utils so env-worker subprocesses can import it without
+pulling in jax/flax (workers must never initialize the TPU runtime;
+envs/worker.py).  Device-side embedding/encoding is
+models/instruction.py.
+"""
+
+import zlib
+
+import numpy as np
+
+NUM_HASH_BUCKETS = 1000  # reference: experiment.py:131
+MAX_INSTRUCTION_LEN = 16
+
+
+def hash_instruction(
+    instruction: str,
+    max_len: int = MAX_INSTRUCTION_LEN,
+    num_buckets: int = NUM_HASH_BUCKETS,
+) -> np.ndarray:
+    """Whitespace-split and hash words to 1-based bucket ids.
+
+    Returns int32 [max_len]; 0 is padding.  Bucket ids are 1..num_buckets
+    so "no token" is distinguishable from any real token.  Uses crc32 — a
+    stable, python-version-independent hash (the reference's in-graph
+    fingerprint hash has the same "small risk of collisions" caveat,
+    reference: experiment.py:129-132).
+
+    Instructions longer than ``max_len`` words are truncated — a
+    deliberate divergence from the reference's unbounded dynamic_rnn:
+    TPU/XLA needs static shapes, and DMLab instructions are short ("go to
+    the red door"); raise ``max_len`` if a level family needs more.
+    """
+    ids = np.zeros([max_len], dtype=np.int32)
+    for i, word in enumerate(instruction.split()[:max_len]):
+        ids[i] = 1 + zlib.crc32(word.encode("utf-8")) % num_buckets
+    return ids

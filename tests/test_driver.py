@@ -71,6 +71,27 @@ class TestDriver:
 
 
 @pytest.mark.slow
+class TestRealSimulator:
+    def test_train_on_gymnasium_cartpole(self, tmp_path):
+        """End-to-end train on a REAL simulator (gymnasium CartPole with
+        rendered frames) — the reference can only do this with
+        VizDoom/DMLab installed; the gym_ family makes it hermetic."""
+        config = small_config(
+            tmp_path, level_name="gym_CartPole-v1", num_actors=4,
+            num_action_repeats=2,
+            total_environment_frames=80)  # 2 updates of 40 frames
+        try:
+            metrics = run_train(config)
+        except Exception as exc:
+            message = str(exc).lower()
+            if "render" in message or "not available" in message:
+                pytest.skip(f"gymnasium unavailable: {exc}")
+            raise
+        assert metrics["env_frames"] == 80
+        assert np.isfinite(metrics["total_loss"])
+
+
+@pytest.mark.slow
 class TestSingleDeviceMesh:
     def test_train_on_one_device_mesh(self, tmp_path):
         """Regression: with a 1-device mesh the actors' weight snapshot

@@ -1,0 +1,255 @@
+"""Simulator-adapter tests.
+
+The real simulators (deepmind_lab, ale-py, vizdoom) are optional
+dependencies that are absent in CI, so the adapter logic is exercised
+against mock simulator modules — the part the reference never tests at
+all (its env tests require the real engines, SURVEY §4).  The gymnasium
+bridge runs against the real gymnasium package.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import create_env, make_impala_stream
+from scalable_agent_tpu.utils.text import hash_instruction
+
+
+# ---------------------------------------------------------------------------
+# DMLab (mocked deepmind_lab)
+# ---------------------------------------------------------------------------
+
+
+class FakeLab:
+    """Duck-typed deepmind_lab.Lab recording calls."""
+
+    instances = []
+
+    def __init__(self, level, observations, config, renderer, level_cache):
+        self.level = level
+        self.observation_names = observations
+        self.config = config
+        self.renderer = renderer
+        self.level_cache = level_cache
+        self.reset_seeds = []
+        self.step_calls = []
+        self._steps = 0
+        self._episode_len = 3
+        self.width = int(config["width"])
+        self.height = int(config["height"])
+        FakeLab.instances.append(self)
+
+    def reset(self, seed=None):
+        self.reset_seeds.append(seed)
+        self._steps = 0
+
+    def observations(self):
+        obs = {"RGB_INTERLEAVED": np.full(
+            (self.height, self.width, 3), self._steps, np.uint8)}
+        if "INSTR" in self.observation_names:
+            obs["INSTR"] = b"go to the red door"
+        return obs
+
+    def step(self, action, num_steps=1):
+        assert action.dtype == np.intc
+        self.step_calls.append((tuple(int(a) for a in action), num_steps))
+        self._steps += 1
+        return 0.5 * num_steps
+
+    def is_running(self):
+        return self._steps < self._episode_len
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def fake_deepmind_lab(monkeypatch):
+    module = types.ModuleType("deepmind_lab")
+    module.Lab = FakeLab
+    module.set_runfiles_path = lambda path: None
+    monkeypatch.setitem(sys.modules, "deepmind_lab", module)
+    FakeLab.instances.clear()
+    yield module
+
+
+class TestDmLabAdapter:
+    def test_level_resolution(self, fake_deepmind_lab):
+        from scalable_agent_tpu.envs.dmlab import resolve_level
+
+        # SF spec table.
+        level, cfg = resolve_level("dmlab_very_sparse")
+        assert level == "contributed/dmlab30/explore_goal_locations_large"
+        assert cfg == {"minGoalDistance": "10"}
+        # DMLab-30 level names.
+        level, _ = resolve_level("dmlab_explore_goal_locations_small")
+        assert level == "contributed/dmlab30/explore_goal_locations_small"
+        # Raw paths.
+        level, _ = resolve_level("dmlab_contributed/dmlab30/rooms_watermaze")
+        assert level == "contributed/dmlab30/rooms_watermaze"
+        with pytest.raises(ValueError, match="unknown DMLab env"):
+            resolve_level("dmlab_not_a_level")
+
+    def test_env_contract(self, fake_deepmind_lab):
+        env = create_env("dmlab_watermaze", width=32, height=24,
+                         num_action_repeats=4, seed=7)
+        lab = FakeLab.instances[-1]
+        assert lab.config["width"] == "32"
+        # Native repeats declared so the stream won't double-wrap.
+        assert env.native_action_repeats == 4
+        obs = env.reset()
+        assert obs.frame.shape == (24, 32, 3)
+        # Instruction hashed host-side to fixed int32 ids.
+        np.testing.assert_array_equal(
+            obs.instruction, hash_instruction("go to the red door"))
+        # Seeded reset chain is reproducible for equal env seeds.
+        env2 = create_env("dmlab_watermaze", width=32, height=24,
+                          num_action_repeats=4, seed=7)
+        env2.reset()
+        assert FakeLab.instances[-1].reset_seeds == lab.reset_seeds
+
+        obs, reward, done, info = env.step(1)
+        assert lab.step_calls[-1] == ((0, 0, 0, -1, 0, 0, 0), 4)  # Backward
+        assert reward == 2.0 and not done and info["num_frames"] == 4
+        env.step(0)
+        obs, reward, done, _ = env.step(0)
+        assert done
+        # Terminal obs is the zero frame (episode has no observations).
+        assert obs.frame.sum() == 0
+        env.close(), env2.close()
+
+    def test_stream_does_not_double_wrap(self, fake_deepmind_lab):
+        stream = make_impala_stream("dmlab_watermaze", seed=3,
+                                    num_action_repeats=4, width=16,
+                                    height=16)
+        stream.initial()
+        lab = FakeLab.instances[-1]
+        stream.step(0)
+        # Exactly ONE Lab.step per agent step, carrying num_steps=4.
+        assert len(lab.step_calls) == 1
+        assert lab.step_calls[0][1] == 4
+        stream.close()
+
+    def test_level_cache_roundtrip(self, tmp_path):
+        from scalable_agent_tpu.envs.dmlab import LevelCache
+
+        cache = LevelCache(str(tmp_path / "cache"))
+        src = tmp_path / "compiled.pk3"
+        src.write_bytes(b"level-bytes")
+        assert not cache.fetch("key1", str(tmp_path / "out.pk3"))
+        cache.write("key1", str(src))
+        out = tmp_path / "out.pk3"
+        assert cache.fetch("key1", str(out))
+        assert out.read_bytes() == b"level-bytes"
+
+
+# ---------------------------------------------------------------------------
+# Atari (mocked ALE behind gymnasium.make)
+# ---------------------------------------------------------------------------
+
+
+class FakeALE:
+    """Duck-typed gymnasium NoFrameskip Atari env."""
+
+    def __init__(self):
+        import gymnasium
+
+        self.observation_space = gymnasium.spaces.Box(
+            0, 255, (210, 160, 3), np.uint8)
+        self.action_space = gymnasium.spaces.Discrete(4)
+        self.steps = 0
+
+    def _obs(self):
+        return np.full((210, 160, 3), self.steps % 256, np.uint8)
+
+    def reset(self, seed=None, options=None):
+        self.steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self.steps += 1
+        return self._obs(), 1.0, False, False, {}
+
+    def close(self):
+        pass
+
+
+class TestAtariAdapter:
+    @pytest.fixture
+    def fake_gym_make(self, monkeypatch):
+        import gymnasium
+
+        made = []
+
+        def fake_make(env_id, **kwargs):
+            made.append((env_id, kwargs))
+            return FakeALE()
+
+        monkeypatch.setattr(gymnasium, "make", fake_make)
+        return made
+
+    def test_pipeline(self, fake_gym_make):
+        env = create_env("atari_breakout", num_action_repeats=4)
+        assert fake_gym_make[0][0] == "BreakoutNoFrameskip-v4"
+        # resize 84x84 grayscale, skip 4 + stack 4 -> [84, 84, 4] HWC.
+        assert env.observation_spec.frame.shape == (84, 84, 4)
+        assert env.native_action_repeats == 4
+        assert env.action_space.n == 4
+        obs = env.reset()
+        assert obs.frame.shape == (84, 84, 4)
+        obs, reward, done, _ = env.step(0)
+        assert reward == 4.0  # summed over the 4 skipped frames
+        env.close()
+
+    def test_unknown_game(self, fake_gym_make):
+        with pytest.raises(ValueError, match="unknown Atari env"):
+            create_env("atari_notagame")
+
+    def test_montezuma_timeout_wrapped(self, fake_gym_make):
+        from scalable_agent_tpu.envs.wrappers import TimeLimitWrapper
+
+        env = create_env("atari_montezuma", num_action_repeats=4)
+        layer = env
+        seen_limit = None
+        while hasattr(layer, "env"):
+            if isinstance(layer, TimeLimitWrapper):
+                seen_limit = layer._limit
+            layer = layer.env
+        assert seen_limit == 18000
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# Gymnasium bridge (real gymnasium, rendered frames)
+# ---------------------------------------------------------------------------
+
+
+class TestGymnasiumBridge:
+    def test_cartpole_rendered_frames(self):
+        try:
+            env = create_env("gym_CartPole-v1", height=72, width=96)
+        except Exception as exc:  # headless render not available
+            pytest.skip(f"gymnasium render unavailable: {exc}")
+        assert env.observation_spec.frame.shape == (72, 96, 3)
+        env.seed(5)
+        obs = env.reset()
+        assert obs.frame.shape == (72, 96, 3)
+        assert obs.frame.dtype == np.uint8
+        obs, reward, done, _ = env.step(0)
+        assert reward == 1.0
+        env.close()
+
+    def test_full_stream_with_repeats(self):
+        try:
+            stream = make_impala_stream(
+                "gym_CartPole-v1", seed=2, num_action_repeats=2,
+                height=32, width=32)
+        except Exception as exc:
+            pytest.skip(f"gymnasium render unavailable: {exc}")
+        out = stream.initial()
+        assert out.done and out.observation.frame.shape == (32, 32, 3)
+        out = stream.step(1)
+        assert out.info.episode_step == 1
+        stream.close()
