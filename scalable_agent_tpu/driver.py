@@ -64,9 +64,11 @@ def env_kwargs(config: Config) -> dict:
     return {}
 
 
-def build_agent(config: Config, num_actions: int) -> ImpalaAgent:
+def build_agent(config: Config, action_space) -> ImpalaAgent:
+    """Policy heads derive from the probed action space — one Discrete
+    head or a composite tuple-categorical (ops/distributions.py)."""
     return ImpalaAgent(
-        num_actions=num_actions,
+        action_space=action_space,
         torso_type=config.torso_type,
         use_instruction=config.use_instruction,
         compute_dtype=jnp.dtype(config.compute_dtype),
@@ -82,7 +84,7 @@ def probe_env(config: Config):
         env.close()
 
 
-def zero_trajectory(config: Config, observation_spec, num_actions: int,
+def zero_trajectory(config: Config, observation_spec, agent: ImpalaAgent,
                     batch: int = 1) -> Trajectory:
     """All-zeros [2, batch] trajectory for shape-only initialization."""
     t_plus_1 = 2
@@ -95,6 +97,8 @@ def zero_trajectory(config: Config, observation_spec, num_actions: int,
     if observation_spec.instruction is not None:
         instr_spec = observation_spec.instruction
         instruction = zeros(instr_spec.shape, instr_spec.dtype)
+    num_components = agent.num_action_components
+    action_shape = () if num_components == 1 else (num_components,)
     return Trajectory(
         agent_state=AgentState(
             c=np.zeros((batch, 256), np.float32),
@@ -110,8 +114,8 @@ def zero_trajectory(config: Config, observation_spec, num_actions: int,
                 instruction=instruction),
         ),
         agent_outputs=AgentOutput(
-            action=zeros((), np.int32),
-            policy_logits=zeros((num_actions,), np.float32),
+            action=zeros(action_shape, np.int32),
+            policy_logits=zeros((agent.num_logits,), np.float32),
             baseline=zeros((), np.float32)),
     )
 
@@ -185,8 +189,7 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     config.save()
     observation_spec, action_space = probe_env(config)
-    num_actions = action_space.n
-    agent = build_agent(config, num_actions)
+    agent = build_agent(config, action_space)
 
     import math
 
@@ -218,7 +221,7 @@ def train(config: Config) -> Dict[str, float]:
 
     ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
                              config.checkpoint_keep)
-    example = zero_trajectory(config, observation_spec, num_actions)
+    example = zero_trajectory(config, observation_spec, agent)
     state = learner.init(jax.random.key(config.seed), example)
     restored = ckpt.restore(target=state)
     if restored is not None:
@@ -306,8 +309,7 @@ def test(config: Config) -> Dict[str, List[float]]:
     """
     config = apply_env_overrides(config)
     observation_spec, action_space = probe_env(config)
-    num_actions = action_space.n
-    agent = build_agent(config, num_actions)
+    agent = build_agent(config, action_space)
 
     # Restore against a structure template so optimizer-state NamedTuples
     # come back typed (only params are used here, but the checkpoint holds
@@ -317,7 +319,7 @@ def test(config: Config) -> Dict[str, List[float]]:
     learner = Learner(agent, hp, mesh, config.frames_per_update())
     template = learner.init(
         jax.random.key(0),
-        zero_trajectory(config, observation_spec, num_actions))
+        zero_trajectory(config, observation_spec, agent))
     ckpt = CheckpointManager(config.logdir)
     restored = ckpt.restore(target=template)
     if restored is None:
@@ -337,7 +339,7 @@ def test(config: Config) -> Dict[str, List[float]]:
     try:
         output = stream.initial()
         core_state = initial_state(1, agent.core_size)
-        action = np.zeros((1,), np.int32)
+        action = np.asarray(agent.zero_actions(1))
         rng = jax.random.key(config.seed)
         step_index = 0
         while len(level_returns[config.level_name]) < config.test_num_episodes:
@@ -349,7 +351,8 @@ def test(config: Config) -> Dict[str, List[float]]:
                 params, jax.random.fold_in(rng, step_index), action,
                 batched, core_state)
             action = np.asarray(agent_out.action)
-            output = stream.step(int(action[0]))
+            # action[0] is a scalar for Discrete, a [K] row for composites.
+            output = stream.step(action[0])
             if output.done:
                 level_returns[config.level_name].append(
                     float(output.info.episode_return))

@@ -21,7 +21,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from scalable_agent_tpu.envs.core import Environment, make_observation
-from scalable_agent_tpu.envs.spaces import Discrete
+from scalable_agent_tpu.envs.spaces import Discrete, Space
 from scalable_agent_tpu.envs.spec import TensorSpec
 from scalable_agent_tpu.types import Observation
 
@@ -48,9 +48,12 @@ class FakeEnv(Environment):
         seed: int = 0,
         with_instruction: bool = False,
         instruction_len: int = 16,
+        action_space: Optional[Space] = None,
     ):
         self._h, self._w, self._c = height, width, channels
-        self.action_space = Discrete(num_actions)
+        # Composite spaces (TupleSpace) exercise the tuple-distribution
+        # path hermetically (reference tests need real Doom for this).
+        self.action_space = action_space or Discrete(num_actions)
         self._episode_length = episode_length
         self._length_jitter = length_jitter
         self._seed = seed
@@ -98,9 +101,15 @@ class FakeEnv(Environment):
         return self._observation(action=0)
 
     def step(self, action) -> Tuple[Observation, float, bool, dict]:
-        action = int(action)
+        arr = np.asarray(action)
+        if arr.ndim == 0:
+            action = int(arr)
+        else:  # composite: one index per subspace
+            action = tuple(int(a) for a in arr)
         if not self.action_space.contains(action):
             raise ValueError(f"action {action} outside {self.action_space}")
+        if isinstance(action, tuple):
+            action = action[0]  # frame encoding uses the first component
         self._step += 1
         done = self._step >= self._episode_len()
         reward = 0.1 * (self._step % 3) + (1.0 if done else 0.0)

@@ -51,7 +51,7 @@ def create_env(full_env_name: str, **kwargs) -> Environment:
 def _make_fake(full_env_name: str, **kwargs) -> Environment:
     from scalable_agent_tpu.envs.fake import FakeEnv
 
-    # e.g. fake_benchmark, fake_small.
+    # e.g. fake_benchmark, fake_small, fake_tuple.
     if full_env_name == "fake_benchmark":
         kwargs.setdefault("height", 72)
         kwargs.setdefault("width", 96)
@@ -60,6 +60,18 @@ def _make_fake(full_env_name: str, **kwargs) -> Environment:
         kwargs.setdefault("height", 16)
         kwargs.setdefault("width", 16)
         kwargs.setdefault("episode_length", 10)
+    elif full_env_name == "fake_tuple":
+        # Composite action space: Tuple(Discrete, Discretized) — the
+        # hermetic stand-in for Doom's composite spaces
+        # (reference: envs/doom/action_space.py:13-138).
+        from scalable_agent_tpu.envs.spaces import (
+            Discrete, Discretized, TupleSpace)
+
+        kwargs.setdefault("height", 16)
+        kwargs.setdefault("width", 16)
+        kwargs.setdefault("episode_length", 10)
+        kwargs.setdefault("action_space", TupleSpace(
+            [Discrete(3), Discretized(5, -1.0, 1.0)]))
     return FakeEnv(**kwargs)
 
 

@@ -19,7 +19,7 @@ experiment.py:109-237), re-designed for TPU/XLA:
   implicit-RNG ops don't exist in JAX).
 """
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,7 @@ from flax import linen as nn
 
 from scalable_agent_tpu.models.instruction import InstructionEncoder
 from scalable_agent_tpu.models.networks import TORSOS
+from scalable_agent_tpu.ops import distributions
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -78,11 +79,37 @@ class ImpalaAgent(nn.Module):
     observation.instruction [T, B, L] int32 or None.
     """
 
-    num_actions: int
+    num_actions: int = 0
     torso_type: str = "shallow"
     use_instruction: bool = False
     core_size: int = CORE_SIZE
     compute_dtype: Any = jnp.float32
+    # Composite policies: a TupleSpace mixing Discrete/Discretized
+    # components (reference: TupleActionDistribution,
+    # algorithms/utils/action_distributions.py:111-201).  When unset, the
+    # policy is one Discrete(num_actions) head, the original layout.
+    action_space: Optional[Any] = None
+
+    @property
+    def dist_spec(self) -> distributions.DistributionSpec:
+        if self.action_space is not None:
+            return distributions.spec_for_space(self.action_space)
+        return distributions.DistributionSpec(sizes=(self.num_actions,))
+
+    @property
+    def num_logits(self) -> int:
+        return self.dist_spec.num_logits
+
+    @property
+    def num_action_components(self) -> int:
+        return self.dist_spec.num_components
+
+    def zero_actions(self, batch: int) -> jnp.ndarray:
+        """All-zeros last-action input at the agent's action layout
+        ([B] for plain Discrete, [B, K] for composites)."""
+        k = self.num_action_components
+        shape = (batch,) if k == 1 else (batch, k)
+        return jnp.zeros(shape, jnp.int32)
 
     @nn.compact
     def __call__(
@@ -91,9 +118,10 @@ class ImpalaAgent(nn.Module):
         env_outputs: StepOutput,
         core_state: AgentState,
     ) -> Tuple[Tuple[jax.Array, jax.Array], AgentState]:
-        unroll_len, batch = actions.shape
+        unroll_len, batch = actions.shape[:2]
         reward, _, done, observation = env_outputs
         frame = observation.frame
+        spec = self.dist_spec
 
         # ---- Torso over the merged [T*B] batch (reference: _torso,
         # experiment.py:148-198, but batched over all timesteps at once).
@@ -104,8 +132,8 @@ class ImpalaAgent(nn.Module):
 
         clipped_reward = jnp.clip(
             jnp.asarray(flat(reward), jnp.float32), -1.0, 1.0)[:, None]
-        one_hot_last_action = jax.nn.one_hot(
-            flat(actions), self.num_actions, dtype=jnp.float32)
+        one_hot_last_action = distributions.one_hot_actions(
+            flat(actions), spec)
         parts = [conv_out, clipped_reward, one_hot_last_action]
         if self.use_instruction:
             instruction = observation.instruction
@@ -131,8 +159,9 @@ class ImpalaAgent(nn.Module):
         # ---- Heads (reference: _head, experiment.py:200-210), again on the
         # merged batch.
         core_flat = core_outputs.reshape((unroll_len * batch, -1))
-        policy_logits = nn.Dense(self.num_actions, name="policy_logits")(
-            core_flat).reshape((unroll_len, batch, self.num_actions))
+        num_logits = self.num_logits
+        policy_logits = nn.Dense(num_logits, name="policy_logits")(
+            core_flat).reshape((unroll_len, batch, num_logits))
         baseline = nn.Dense(1, name="baseline")(core_flat).reshape(
             (unroll_len, batch))
         return (policy_logits, baseline), new_state
@@ -159,9 +188,11 @@ def actor_step(
     env_outputs = map_structure(expand, env_output)
     (policy_logits, baseline), new_state = agent.apply(
         params, actions, env_outputs, core_state)
-    policy_logits = policy_logits[0]  # [B, A]
+    policy_logits = policy_logits[0]  # [B, num_logits]
     baseline = baseline[0]  # [B]
-    action = jax.random.categorical(rng, policy_logits, axis=-1)
+    # Composite spaces sample every component ([B, K]); plain Discrete
+    # keeps the [B] layout.
+    action = distributions.sample(rng, policy_logits, agent.dist_spec)
     return (
         AgentOutput(
             action=jnp.asarray(action, jnp.int32),

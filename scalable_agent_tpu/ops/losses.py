@@ -6,9 +6,19 @@ and reward clipping modes (reference: experiment.py:377-382).  All terms are
 hyperparameters like entropy_cost transfer unchanged.
 """
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from scalable_agent_tpu.ops import distributions
+
+
+def _default_spec(logits, dist_spec):
+    if dist_spec is not None:
+        return dist_spec
+    return distributions.DistributionSpec(sizes=(logits.shape[-1],))
 
 
 def compute_baseline_loss(advantages) -> jax.Array:
@@ -16,23 +26,32 @@ def compute_baseline_loss(advantages) -> jax.Array:
     return 0.5 * jnp.sum(jnp.square(jnp.asarray(advantages, jnp.float32)))
 
 
-def compute_entropy_loss(logits) -> jax.Array:
-    """Negative total policy entropy.  (reference: experiment.py:332-336)"""
-    log_policy = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
-    policy = jnp.exp(log_policy)
-    entropy_per_timestep = jnp.sum(-policy * log_policy, axis=-1)
+def compute_entropy_loss(
+        logits,
+        dist_spec: Optional[distributions.DistributionSpec] = None,
+) -> jax.Array:
+    """Negative total policy entropy; for composite policies the joint
+    entropy is the sum over components.  (reference: experiment.py:332-336;
+    TupleActionDistribution.entropy, action_distributions.py:180-184)"""
+    logits = jnp.asarray(logits, jnp.float32)
+    entropy_per_timestep = distributions.entropy(
+        logits, _default_spec(logits, dist_spec))
     return -jnp.sum(entropy_per_timestep)
 
 
-def compute_policy_gradient_loss(logits, actions, advantages) -> jax.Array:
-    """sum(cross_entropy(actions) * stop_grad(advantages)).
+def compute_policy_gradient_loss(
+        logits, actions, advantages,
+        dist_spec: Optional[distributions.DistributionSpec] = None,
+) -> jax.Array:
+    """sum(cross_entropy(actions) * stop_grad(advantages)); composite
+    policies sum component cross-entropies (independent heads).
 
     (reference: experiment.py:339-343)
     """
-    log_pi = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
-    cross_entropy = -jnp.take_along_axis(
-        log_pi, jnp.asarray(actions, jnp.int32)[..., None], axis=-1
-    ).squeeze(-1)
+    logits = jnp.asarray(logits, jnp.float32)
+    cross_entropy = -distributions.log_prob(
+        logits, jnp.asarray(actions, jnp.int32),
+        _default_spec(logits, dist_spec))
     return jnp.sum(cross_entropy * lax.stop_gradient(advantages))
 
 

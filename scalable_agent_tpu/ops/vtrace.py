@@ -157,25 +157,42 @@ def from_logits(
     clip_rho_threshold: Optional[float] = 1.0,
     clip_pg_rho_threshold: Optional[float] = 1.0,
     scan_impl: str = "associative",
+    dist_spec=None,
 ) -> VTraceFromLogitsReturns:
     """V-trace for softmax policies.  (reference: vtrace.py:71-161)
 
-    behaviour/target logits: [T, B, NUM_ACTIONS]; actions: [T, B] int;
+    behaviour/target logits: [T, B, NUM_LOGITS]; actions: [T, B] int
+    ([T, B, K] for composite policies with ``dist_spec``);
     discounts/rewards/values: [T, B]; bootstrap_value: [B].
+
+    ``dist_spec`` (ops/distributions.DistributionSpec): composite
+    tuple-categorical policies — log-rhos become joint (summed) component
+    log-prob ratios, the natural generalization the reference never built
+    (its V-trace is single-categorical only, vtrace.py:45-68).
     """
     behaviour_policy_logits = jnp.asarray(behaviour_policy_logits, jnp.float32)
     target_policy_logits = jnp.asarray(target_policy_logits, jnp.float32)
     actions = jnp.asarray(actions, jnp.int32)
 
     if behaviour_policy_logits.ndim != 3 or target_policy_logits.ndim != 3:
-        raise ValueError("policy logits must be rank 3 [T, B, NUM_ACTIONS]")
-    if actions.ndim != 2:
-        raise ValueError("actions must be rank 2 [T, B]")
+        raise ValueError("policy logits must be rank 3 [T, B, NUM_LOGITS]")
+    if dist_spec is None or dist_spec.num_components == 1:
+        if actions.ndim != 2:
+            raise ValueError("actions must be rank 2 [T, B]")
+        behaviour_action_log_probs = log_probs_from_logits_and_actions(
+            behaviour_policy_logits, actions)
+        target_action_log_probs = log_probs_from_logits_and_actions(
+            target_policy_logits, actions)
+    else:
+        from scalable_agent_tpu.ops import distributions
 
-    behaviour_action_log_probs = log_probs_from_logits_and_actions(
-        behaviour_policy_logits, actions)
-    target_action_log_probs = log_probs_from_logits_and_actions(
-        target_policy_logits, actions)
+        if actions.ndim != 3:
+            raise ValueError(
+                "composite actions must be rank 3 [T, B, K]")
+        behaviour_action_log_probs = distributions.log_prob(
+            behaviour_policy_logits, actions, dist_spec)
+        target_action_log_probs = distributions.log_prob(
+            target_policy_logits, actions, dist_spec)
     log_rhos = target_action_log_probs - behaviour_action_log_probs
 
     vtrace_returns = from_importance_weights(

