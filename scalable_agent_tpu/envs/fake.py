@@ -49,8 +49,15 @@ class FakeEnv(Environment):
         with_instruction: bool = False,
         instruction_len: int = 16,
         action_space: Optional[Space] = None,
+        num_action_repeats: int = 1,
     ):
         self._h, self._w, self._c = height, width, channels
+        # Native action repeats, like DMLab's ``num_steps`` (reference:
+        # environments.py:111): one ``step`` call advances the simulator
+        # ``num_action_repeats`` sub-steps with summed rewards and
+        # early-stop on done — bit-identical to wrapping a repeats=1
+        # FakeEnv in SkipFramesWrapper, but one Python call instead of k.
+        self.native_action_repeats = max(1, int(num_action_repeats))
         # Composite spaces (TupleSpace) exercise the tuple-distribution
         # path hermetically (reference tests need real Doom for this).
         self.action_space = action_space or Discrete(num_actions)
@@ -110,9 +117,15 @@ class FakeEnv(Environment):
             raise ValueError(f"action {action} outside {self.action_space}")
         if isinstance(action, tuple):
             action = action[0]  # frame encoding uses the first component
-        self._step += 1
-        done = self._step >= self._episode_len()
-        reward = 0.1 * (self._step % 3) + (1.0 if done else 0.0)
+        reward = 0.0
+        done = False
+        episode_len = self._episode_len()
+        for _ in range(self.native_action_repeats):
+            self._step += 1
+            done = self._step >= episode_len
+            reward += 0.1 * (self._step % 3) + (1.0 if done else 0.0)
+            if done:
+                break
         return self._observation(action), np.float32(reward), done, {}
 
     def render(self, mode: str = "rgb_array"):

@@ -105,7 +105,7 @@ _make_gym = _lazy_family(
     "gym_", "scalable_agent_tpu.envs.gym_adapter", "make_gym_env")
 
 
-register_family("fake_", _make_fake)
+register_family("fake_", _make_fake, consumes_action_repeats=True)
 register_family("doom_", _make_doom, consumes_action_repeats=True)
 register_family("atari_", _make_atari, consumes_action_repeats=True)
 register_family("dmlab_", _make_dmlab, consumes_action_repeats=True)

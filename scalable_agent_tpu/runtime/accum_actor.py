@@ -1,0 +1,267 @@
+"""On-device trajectory accumulation for the actor runtime.
+
+The structural ``VectorActor`` (runtime/actor.py) round-trips the full
+agent output to the host every step and re-uploads the assembled
+trajectory to the device for the learner — the host↔device link carries
+every observation TWICE plus per-step logits/baselines, and the host pays
+a blocking fetch latency for each of them.  On hardware where that link
+is expensive (any TPU, and catastrophically so over a remote-tunnel
+attachment), the actor loop becomes link-bound, not compute-bound.
+
+This module inverts the data flow, which is the idiomatic JAX answer:
+
+- Per step the host uploads exactly TWO arrays — the frame batch as FLAT
+  bytes (multi-dim uint8 ``device_put`` pays an order-of-magnitude layout
+  penalty over some transports; reshape is free inside XLA) and one
+  packed ``[4, B]`` f32 array of (reward, done, episode_return,
+  episode_step) — and fetches exactly ONE: the sampled actions the
+  simulators need.  Nothing else crosses.
+- The jitted step writes the incoming env fields and the computed agent
+  outputs into a device-resident ``[T+1, B, ...]`` trajectory buffer via
+  donated in-place ``dynamic_update_slice``.
+- At unroll end the buffer IS the learner's ``Trajectory`` — zero
+  re-upload, zero host-side stacking — and a fresh buffer for the next
+  unroll is seeded with the T+1 overlap entry (the reference's
+  first-entry-is-last-entry layout, reference: experiment.py:311-321).
+
+The trajectory layout, rng stream, and math are identical to the
+structural path (tests/test_accum_actor.py asserts trajectory
+equivalence), so the learner and V-trace see the same data either way.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.models.agent import (
+    ImpalaAgent,
+    actor_step,
+    initial_state,
+)
+from scalable_agent_tpu.types import (
+    ActorOutput,
+    AgentOutput,
+    AgentState,
+    Observation,
+    StepOutput,
+    StepOutputInfo,
+)
+
+
+def _pack_env_fields(env_output: StepOutput) -> np.ndarray:
+    """Small per-step env fields -> ONE [4, B] f32 host array (one upload
+    instead of four; episode_step fits f32 exactly below 2^24)."""
+    return np.stack([
+        np.asarray(env_output.reward, np.float32),
+        np.asarray(env_output.done, np.float32),
+        np.asarray(env_output.info.episode_return, np.float32),
+        np.asarray(env_output.info.episode_step, np.float32),
+    ])
+
+
+class AccumPrograms:
+    """The jitted step/finish/bootstrap programs for one (agent, T, B,
+    frame-shape) signature.  Build ONCE per ActorPool and share across
+    groups so every group hits the same executable cache."""
+
+    def __init__(self, agent: ImpalaAgent, unroll_length: int,
+                 batch: int, frame_shape: Tuple[int, ...]):
+        self.agent = agent
+        self.unroll_length = unroll_length
+        self.batch = batch
+        self.frame_shape = tuple(frame_shape)
+        t1 = unroll_length + 1
+        k = agent.num_action_components
+        self._action_shape = (batch,) if k == 1 else (batch, k)
+        self._bufs_shape = dict(
+            frame=(t1, batch) + self.frame_shape,
+            small=(t1,),  # [T+1, B] fields share this prefix
+            action=(t1,) + self._action_shape,
+            logits=(t1, batch, agent.num_logits),
+        )
+
+        self.step = jax.jit(self._step_impl, donate_argnums=(5,))
+        self.finish = jax.jit(self._finish_impl, donate_argnums=(2,))
+        self.bootstrap = jax.jit(self._bootstrap_impl)
+
+    # -- buffer pytree -----------------------------------------------------
+
+    def _unpack(self, frame_flat, packed):
+        """(flat frame bytes, [4,B] f32) -> StepOutput batch."""
+        frame = frame_flat.reshape((self.batch,) + self.frame_shape)
+        return StepOutput(
+            reward=packed[0],
+            info=StepOutputInfo(
+                episode_return=packed[2],
+                episode_step=packed[3].astype(jnp.int32)),
+            done=packed[1] > 0.5,
+            observation=Observation(frame=frame, instruction=None),
+        )
+
+    def _zero_bufs(self):
+        t1 = self.unroll_length + 1
+        b = self.batch
+        return (
+            StepOutput(
+                reward=jnp.zeros((t1, b), jnp.float32),
+                info=StepOutputInfo(
+                    episode_return=jnp.zeros((t1, b), jnp.float32),
+                    episode_step=jnp.zeros((t1, b), jnp.int32)),
+                done=jnp.zeros((t1, b), bool),
+                observation=Observation(
+                    frame=jnp.zeros(self._bufs_shape["frame"], jnp.uint8),
+                    instruction=None),
+            ),
+            AgentOutput(
+                action=jnp.zeros(self._bufs_shape["action"], jnp.int32),
+                policy_logits=jnp.zeros(
+                    self._bufs_shape["logits"], jnp.float32),
+                baseline=jnp.zeros((t1, b), jnp.float32),
+            ),
+        )
+
+    @staticmethod
+    def _write(bufs, slot, env_entry=None, agent_entry=None):
+        """Write one [B, ...] entry at time index ``slot`` (traced)."""
+        env_bufs, agent_bufs = bufs
+
+        def put(buf, val):
+            if buf is None:
+                return None
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, val.astype(buf.dtype), slot, axis=0)
+
+        if env_entry is not None:
+            env_bufs = jax.tree_util.tree_map(
+                put, env_bufs, env_entry,
+                is_leaf=lambda x: x is None)
+        if agent_entry is not None:
+            agent_bufs = jax.tree_util.tree_map(
+                put, agent_bufs, agent_entry,
+                is_leaf=lambda x: x is None)
+        return (env_bufs, agent_bufs)
+
+    # -- programs ----------------------------------------------------------
+
+    def _bootstrap_impl(self, frame_flat, packed):
+        """First-ever entry: env slot 0 = initial output, agent slot 0 =
+        zeros (reference: experiment.py:243-251)."""
+        env_entry = self._unpack(frame_flat, packed)
+        agent_entry = AgentOutput(
+            action=jnp.zeros(self._action_shape, jnp.int32),
+            policy_logits=jnp.zeros(
+                (self.batch, self.agent.num_logits), jnp.float32),
+            baseline=jnp.zeros((self.batch,), jnp.float32),
+        )
+        return self._write(self._zero_bufs(), 0, env_entry, agent_entry)
+
+    def _step_impl(self, params, seed, counter, slot, frame_flat, bufs,
+                   packed, core_state):
+        """Iteration ``slot`` (1-based): the incoming env fields are
+        entry ``slot-1``; the computed agent output is entry ``slot``.
+
+        The last action feeding the model is read back from agent slot
+        ``slot-1`` on device — it never crosses to the host."""
+        env_entry = self._unpack(frame_flat, packed)
+        bufs = self._write(bufs, slot - 1, env_entry=env_entry)
+        last_action = jax.lax.dynamic_index_in_dim(
+            bufs[1].action, slot - 1, axis=0, keepdims=False)
+        rng = jax.random.fold_in(jax.random.key(seed), counter)
+        out, new_core = actor_step(
+            self.agent, params, rng, last_action, env_entry, core_state)
+        bufs = self._write(bufs, slot, agent_entry=out)
+        return out.action, new_core, bufs
+
+    def _finish_impl(self, frame_flat, packed, bufs):
+        """Seal the unroll: write env slot T (the output of the host env
+        step taken AFTER the last inference), emit the trajectory, and
+        seed the next unroll's buffers with the overlap entry."""
+        t = self.unroll_length
+        env_entry = self._unpack(frame_flat, packed)
+        traj = self._write(bufs, t, env_entry=env_entry)
+        last_agent = jax.tree_util.tree_map(
+            lambda x: None if x is None else x[t], traj[1],
+            is_leaf=lambda x: x is None)
+        next_bufs = self._write(
+            self._zero_bufs(), 0, env_entry=env_entry,
+            agent_entry=last_agent)
+        return traj, next_bufs
+
+
+class AccumVectorActor:
+    """One env group driven through the accumulation programs.
+
+    Drop-in for ``VectorActor``: ``run_unroll(params) -> ActorOutput``
+    whose array leaves live on device."""
+
+    def __init__(
+        self,
+        programs: AccumPrograms,
+        envs: MultiEnv,
+        level_name: str = "",
+        seed: int = 0,
+    ):
+        if envs.num_envs != programs.batch:
+            raise ValueError(
+                f"group size {envs.num_envs} != programs batch "
+                f"{programs.batch}")
+        self._p = programs
+        self._envs = envs
+        self.level_name = level_name
+        self._seed = np.int32(seed)
+        self._counter = 0
+        self._bufs = None
+        self._core_state = None
+        self._last_env_host: Optional[StepOutput] = None
+
+    @staticmethod
+    def _flat_frame(env_output: StepOutput) -> np.ndarray:
+        frame = np.asarray(env_output.observation.frame)
+        return frame.reshape(-1)  # free view; MultiEnv hands a fresh copy
+
+    def _upload(self, env_output: StepOutput):
+        if env_output.observation.instruction is not None:
+            raise NotImplementedError(
+                "accum inference mode does not carry instructions yet; "
+                "use inference_mode='structural'")
+        return (self._flat_frame(env_output),
+                _pack_env_fields(env_output))
+
+    def run_unroll(self, params) -> ActorOutput:
+        p = self._p
+        if self._bufs is None:
+            self._last_env_host = self._envs.initial()
+            self._bufs = p.bootstrap(*self._upload(self._last_env_host))
+            self._core_state = initial_state(
+                p.batch, p.agent.core_size)
+
+        first_state = AgentState(
+            c=self._core_state.c, h=self._core_state.h)
+        core_state = self._core_state
+        bufs = self._bufs
+        for slot in range(1, p.unroll_length + 1):
+            self._counter += 1
+            frame_flat, packed = self._upload(self._last_env_host)
+            action_dev, core_state, bufs = p.step(
+                params, self._seed, np.int32(self._counter),
+                np.int32(slot), frame_flat, bufs, packed, core_state)
+            actions = np.asarray(action_dev)  # the ONLY per-step fetch
+            self._envs.step_send(actions)
+            self._last_env_host = self._envs.step_recv()
+
+        traj, self._bufs = p.finish(*self._upload(self._last_env_host),
+                                    bufs)
+        self._core_state = core_state
+        env_bufs, agent_bufs = traj
+        return ActorOutput(
+            level_name=self.level_name,
+            agent_state=first_state,
+            env_outputs=env_bufs,
+            agent_outputs=agent_bufs,
+        )
+
+    def close(self):
+        self._envs.close()

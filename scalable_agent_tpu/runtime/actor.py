@@ -209,14 +209,38 @@ class ActorPool:
             self._service_jit = jax.jit(functools.partial(
                 _service_step, agent))
             step_fn = self._service_request
-        else:
+        elif inference_mode != "accum":
             raise ValueError(f"unknown inference_mode {inference_mode!r}")
         self._inference_mode = inference_mode
-        self._actors = [
-            VectorActor(agent, envs, unroll_length, level_name=level_name,
-                        seed=seed + 1000 * i, step_fn=step_fn)
-            for i, envs in enumerate(env_groups)
-        ]
+        if inference_mode == "accum":
+            # On-device trajectory accumulation: per step only flat frame
+            # bytes go up and sampled actions come down; the trajectory
+            # never re-crosses the link (runtime/accum_actor.py).
+            from scalable_agent_tpu.runtime.accum_actor import (
+                AccumPrograms,
+                AccumVectorActor,
+            )
+
+            sizes = {envs.num_envs for envs in env_groups}
+            if len(sizes) > 1:
+                raise ValueError(
+                    f"accum inference needs uniform group sizes, got "
+                    f"{sorted(sizes)}")
+            programs = AccumPrograms(
+                agent, unroll_length, env_groups[0].num_envs,
+                env_groups[0].frame_slab().shape[1:])
+            self._actors = [
+                AccumVectorActor(programs, envs, level_name=level_name,
+                                 seed=seed + 1000 * i)
+                for i, envs in enumerate(env_groups)
+            ]
+        else:
+            self._actors = [
+                VectorActor(agent, envs, unroll_length,
+                            level_name=level_name, seed=seed + 1000 * i,
+                            step_fn=step_fn)
+                for i, envs in enumerate(env_groups)
+            ]
         self.queue = queue_lib.Queue(
             maxsize=queue_capacity or len(env_groups))
         self._params = None

@@ -1,0 +1,177 @@
+"""On-device trajectory accumulation (runtime/accum_actor.py).
+
+The accum path must be a pure data-flow optimization: given identical env
+seeds, params, and rng seeds it must emit byte-identical trajectories to
+the structural ``VectorActor`` path — same [T+1, B] layout, same overlap
+entry, same rng stream (the learner cannot tell which actor produced a
+batch).  Plus an end-to-end ActorPool(inference_mode='accum') → Learner
+consumption test mirroring the structural/service ones.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.models import ImpalaAgent
+from scalable_agent_tpu.models import agent as agent_mod
+from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+from scalable_agent_tpu.runtime import (
+    ActorPool,
+    Learner,
+    LearnerHyperparams,
+    Trajectory,
+    VectorActor,
+)
+from scalable_agent_tpu.runtime.accum_actor import (
+    AccumPrograms,
+    AccumVectorActor,
+)
+
+NUM_ACTIONS = 5
+FRAME = TensorSpec((16, 16, 3), np.uint8, "frame")
+T = 6
+B = 4
+
+
+def make_envs(n=B, workers=2):
+    fns = [functools.partial(make_impala_stream, "fake_small", seed=i,
+                             num_actions=NUM_ACTIONS)
+           for i in range(n)]
+    return MultiEnv(fns, FRAME, num_workers=workers)
+
+
+@pytest.fixture(scope="module")
+def agent_and_params():
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+    envs = make_envs(1, workers=1)
+    try:
+        params = agent.init(
+            jax.random.key(0),
+            np.zeros((1, 1), np.int32),
+            jax.tree_util.tree_map(
+                lambda x: None if x is None else np.asarray(x)[None][:, :1],
+                envs.initial(), is_leaf=lambda x: x is None),
+            agent_mod.initial_state(1))
+    finally:
+        envs.close()
+    return agent, params
+
+
+def tree_as_numpy(tree):
+    return jax.tree_util.tree_map(
+        lambda x: None if x is None else np.asarray(x), tree,
+        is_leaf=lambda x: x is None)
+
+
+class TestEquivalence:
+    def test_trajectories_match_structural_path(self, agent_and_params):
+        agent, params = agent_and_params
+        envs_a = make_envs()
+        envs_b = make_envs()
+        try:
+            structural = VectorActor(agent, envs_a, T, seed=7)
+            programs = AccumPrograms(agent, T, B, FRAME.shape)
+            accum = AccumVectorActor(programs, envs_b, seed=7)
+            for unroll_index in range(3):
+                out_s = structural.run_unroll(params)
+                out_a = accum.run_unroll(params)
+                s = tree_as_numpy(out_s)
+                a = tree_as_numpy(out_a)
+                np.testing.assert_array_equal(
+                    s.env_outputs.observation.frame,
+                    a.env_outputs.observation.frame,
+                    err_msg=f"frames diverge at unroll {unroll_index}")
+                np.testing.assert_array_equal(
+                    s.agent_outputs.action, a.agent_outputs.action)
+                np.testing.assert_array_equal(
+                    s.env_outputs.done, a.env_outputs.done)
+                np.testing.assert_allclose(
+                    s.env_outputs.reward, a.env_outputs.reward, rtol=1e-6)
+                np.testing.assert_allclose(
+                    s.env_outputs.info.episode_return,
+                    a.env_outputs.info.episode_return, rtol=1e-6)
+                np.testing.assert_array_equal(
+                    s.env_outputs.info.episode_step,
+                    a.env_outputs.info.episode_step)
+                np.testing.assert_allclose(
+                    s.agent_outputs.policy_logits,
+                    a.agent_outputs.policy_logits, rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(
+                    s.agent_outputs.baseline, a.agent_outputs.baseline,
+                    rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(
+                    s.agent_state.c, a.agent_state.c, rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(
+                    s.agent_state.h, a.agent_state.h, rtol=1e-5, atol=1e-6)
+        finally:
+            envs_a.close()
+            envs_b.close()
+
+    def test_overlap_entry_carries_across_unrolls(self, agent_and_params):
+        """Entry 0 of unroll k+1 == entry T of unroll k (reference
+        trajectory layout, experiment.py:311-321)."""
+        agent, params = agent_and_params
+        envs = make_envs()
+        try:
+            programs = AccumPrograms(agent, T, B, FRAME.shape)
+            actor = AccumVectorActor(programs, envs, seed=3)
+            first = tree_as_numpy(actor.run_unroll(params))
+            second = tree_as_numpy(actor.run_unroll(params))
+            np.testing.assert_array_equal(
+                first.env_outputs.observation.frame[T],
+                second.env_outputs.observation.frame[0])
+            np.testing.assert_array_equal(
+                first.agent_outputs.action[T],
+                second.agent_outputs.action[0])
+            np.testing.assert_allclose(
+                first.agent_outputs.policy_logits[T],
+                second.agent_outputs.policy_logits[0])
+        finally:
+            envs.close()
+
+
+class TestActorPoolAccumMode:
+    def test_pool_accum_feeds_learner(self, agent_and_params):
+        agent, params = agent_and_params
+        mesh = make_mesh(MeshSpec(data=B, model=1),
+                         devices=jax.devices()[:B])
+        hp = LearnerHyperparams(total_environment_frames=1e6)
+        learner = Learner(agent, hp, mesh, frames_per_update=T * B)
+        groups = [make_envs(B, workers=2) for _ in range(2)]
+        pool = ActorPool(agent, groups, unroll_length=T, seed=11,
+                         inference_mode="accum")
+        pool.set_params(params)
+        pool.start()
+        try:
+            state = None
+            for _ in range(3):
+                out = pool.get_trajectory(timeout=60)
+                traj = Trajectory(
+                    agent_state=out.agent_state,
+                    env_outputs=out.env_outputs,
+                    agent_outputs=out.agent_outputs)
+                assert traj.agent_outputs.action.shape == (T + 1, B)
+                if state is None:
+                    state = learner.init(jax.random.key(4), traj)
+                state, metrics = learner.update(
+                    state, learner.put_trajectory(traj))
+                pool.set_params(state.params)
+            assert np.isfinite(float(metrics["total_loss"]))
+            assert float(metrics["env_frames"]) == 3 * T * B
+        finally:
+            pool.stop()
+
+    def test_accum_rejects_ragged_groups(self, agent_and_params):
+        agent, _ = agent_and_params
+        groups = [make_envs(2, workers=1), make_envs(3, workers=1)]
+        try:
+            with pytest.raises(ValueError, match="uniform group sizes"):
+                ActorPool(agent, groups, unroll_length=T,
+                          inference_mode="accum")
+        finally:
+            for g in groups:
+                g.close()

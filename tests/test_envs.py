@@ -121,16 +121,16 @@ class TestStreams:
         stream.close()
 
     def test_native_repeats_not_double_wrapped(self):
-        env = small_env()
-        env.native_action_repeats = 4
+        env = small_env(episode_length=100, num_action_repeats=4)
         import scalable_agent_tpu.envs.registry as registry
         registry.register_family("nativerep_", lambda name, **kw: env)
         try:
             stream = make_impala_stream("nativerep_x", num_action_repeats=4)
             stream.initial()
             out = stream.step(0)
-            # Un-wrapped: a single underlying step.
-            assert out.observation.frame[0, 1, 0] == 1
+            # Natively repeated once (4 simulator sub-steps); a second
+            # SkipFramesWrapper layer would have advanced 16.
+            assert out.observation.frame[0, 1, 0] == 4
         finally:
             registry._FACTORIES.pop("nativerep_", None)
 
