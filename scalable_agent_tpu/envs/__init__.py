@@ -5,6 +5,11 @@ from scalable_agent_tpu.envs.core import (
     StreamAdapter,
     Wrapper,
 )
+# NOTE: envs.device (the in-graph env layer) is deliberately NOT
+# re-exported here: this package __init__ is imported by spawned env
+# worker subprocesses, which must stay jax-free (spawn latency, and the
+# TPU runtime must never initialize in children).  Import it as
+# ``from scalable_agent_tpu.envs import device`` on the parent side only.
 from scalable_agent_tpu.envs.fake import FakeEnv
 from scalable_agent_tpu.envs.registry import (
     create_env,

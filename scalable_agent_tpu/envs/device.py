@@ -1,0 +1,203 @@
+"""In-graph (on-device) environments: envs as pure XLA functions.
+
+The reference steps its environments *inside* the TF graph through
+``tf.py_func`` pipes to subprocesses (reference: py_process.py:97-112,
+environments.py:149-233) — the graph stalls on the host every step.  The
+TPU-native inversion: an environment whose transition function is
+expressible in XLA runs ON the accelerator, vectorized over the batch,
+inside the same jitted program as agent inference — an entire unroll (or
+the whole train step) becomes ONE device launch with zero per-step
+host↔device traffic.  This is the standard JAX-RL architecture
+(gymnax/Brax-style) and is what lets the framework saturate a chip whose
+host link is slow (e.g. a remote TPU attachment).
+
+``DeviceFakeEnv`` mirrors the host ``FakeEnv`` (envs/fake.py) transition
+math EXACTLY — same frames, rewards, episode boundaries, auto-reset and
+episode accounting as ``ImpalaStream(StreamAdapter(FakeEnv(...)))`` — so
+on-device rollouts are interchangeable with host rollouts
+(tests/test_device_env.py asserts step-by-step equality).  It also serves
+as the zero-simulator-cost throughput benchmark backend (the role of the
+reference's ``doom_benchmark`` spec, envs/doom/doom_utils.py:125-129).
+
+Integer caveat: the host FakeEnv mixes seeds with Python bigints; the
+device mirror uses int32, which is exact for ``seed < 2**31 / 1000003``
+(seed <= 2147 with length_jitter) or ``seed < 2**31 / 131`` (seed <= 16M
+without).  The vectorized constructors check this.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.envs.spaces import Discrete
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.types import (
+    Observation,
+    StepOutput,
+    StepOutputInfo,
+)
+
+
+class DeviceEnvState(NamedTuple):
+    """Per-env simulator + episode-accounting state, all [B]."""
+
+    seed: jax.Array  # i32, fixed per env
+    episode: jax.Array  # i32
+    step: jax.Array  # i32, simulator step within the episode
+    episode_return: jax.Array  # f32, ImpalaStream carried accumulator
+    episode_step: jax.Array  # i32, agent steps within the episode
+
+
+class DeviceFakeEnv:
+    """[B]-vectorized pure-function mirror of ``envs.fake.FakeEnv``.
+
+    ``initial(seeds)`` and ``step(state, action)`` are pure jnp functions
+    usable under ``jit``/``scan``/``vmap``; both return
+    ``(DeviceEnvState, StepOutput)`` with the exact field semantics of
+    the host ``ImpalaStream`` (reward sums over native action repeats,
+    done folds termination, observation after done is the next episode's
+    first frame, emitted info includes the final step while the carried
+    accounting resets — reference: environments.py:103-117, 198-233).
+    """
+
+    def __init__(
+        self,
+        height: int = 72,
+        width: int = 96,
+        channels: int = 3,
+        num_actions: int = 9,
+        episode_length: int = 10,
+        length_jitter: int = 0,
+        num_action_repeats: int = 1,
+    ):
+        self.height = height
+        self.width = width
+        self.channels = channels
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        self.length_jitter = length_jitter
+        self.num_action_repeats = max(1, int(num_action_repeats))
+        self.action_space = Discrete(num_actions)
+        self.observation_spec = Observation(
+            frame=TensorSpec((height, width, channels), np.uint8, "frame"),
+            instruction=None)
+        self._max_seed = (2**31 - 1) // (
+            1000003 if length_jitter > 0 else 131)
+
+    # -- pure transition math (mirrors FakeEnv line by line) ---------------
+
+    def _episode_len(self, seed, episode):
+        if self.length_jitter <= 0:
+            return jnp.full_like(episode, self.episode_length)
+        # Modular arithmetic term-by-term: identical to the host's
+        # bigint ``(seed*1000003 + episode*7919) % m`` but int32-safe for
+        # ANY episode count (seed*1000003 is bounded by the constructor
+        # guard; (m-1)*(7919%m) stays far below 2**31 for m <= 2**15).
+        m = self.length_jitter + 1
+        mix = ((seed * 1000003) % m + (episode % m) * (7919 % m)) % m
+        return self.episode_length + mix
+
+    def _frame(self, seed, episode, step, action):
+        """uint8 [B, H, W, C]: constant base with 3 encoded pixels
+        (FakeEnv._frame, envs/fake.py).  Same term-by-term mod-251
+        arithmetic: exact vs the host bigints, overflow-free for any
+        episode/step count."""
+        base = (((seed * 131) % 251 + (episode % 251) * 17
+                 + (step % 251) * 7) % 251).astype(jnp.uint8)
+        b = base.shape[0]
+        frame = jnp.broadcast_to(
+            base[:, None, None, None],
+            (b, self.height, self.width, self.channels))
+        frame = frame.at[:, 0, 0, 0].set((episode % 256).astype(jnp.uint8))
+        frame = frame.at[:, 0, 1, 0].set((step % 256).astype(jnp.uint8))
+        frame = frame.at[:, 0, 2, 0].set((action % 256).astype(jnp.uint8))
+        return frame
+
+    def initial(self, seeds) -> Tuple[DeviceEnvState, StepOutput]:
+        """Reset all envs: episode 0, step 0 — ImpalaStream.initial()
+        emits reward 0, zero info, done=True ("start of episode")."""
+        if not isinstance(seeds, jax.core.Tracer):
+            host_seeds = np.asarray(seeds)
+            if (np.abs(host_seeds) > self._max_seed).any():
+                raise ValueError(
+                    f"device FakeEnv seeds must stay below "
+                    f"{self._max_seed} for exact host-mirror arithmetic")
+        seeds = jnp.asarray(seeds, jnp.int32)
+        b = seeds.shape[0]
+
+        # One DISTINCT buffer per leaf: sharing one zeros array across
+        # leaves makes any later donation of the containing pytree fail
+        # with "attempt to donate the same buffer twice".
+        def zero_i():
+            return jnp.zeros((b,), jnp.int32)
+
+        def zero_f():
+            return jnp.zeros((b,), jnp.float32)
+
+        state = DeviceEnvState(
+            seed=seeds, episode=zero_i(), step=zero_i(),
+            episode_return=zero_f(), episode_step=zero_i())
+        output = StepOutput(
+            reward=zero_f(),
+            info=StepOutputInfo(
+                episode_return=zero_f(), episode_step=zero_i()),
+            done=jnp.ones((b,), bool),
+            observation=Observation(
+                frame=self._frame(seeds, state.episode, state.step,
+                                  state.episode_step),
+                instruction=None),
+        )
+        return state, output
+
+    def step(self, state: DeviceEnvState, action
+             ) -> Tuple[DeviceEnvState, StepOutput]:
+        """One agent step = ``num_action_repeats`` masked simulator
+        sub-steps with summed rewards and early stop on done, then
+        auto-reset (StreamAdapter) and episode accounting (ImpalaStream).
+        """
+        action = jnp.asarray(action, jnp.int32)
+        if action.ndim > 1:  # composite: frame encoding uses component 0
+            action = action[:, 0]
+        ep_len = self._episode_len(state.seed, state.episode)
+        step = state.step
+        reward = jnp.zeros_like(state.episode_return)
+        done = jnp.zeros_like(step, dtype=bool)
+        for _ in range(self.num_action_repeats):
+            active = ~done
+            step = step + active.astype(jnp.int32)
+            sub_done = active & (step >= ep_len)
+            reward = reward + jnp.where(
+                active, 0.1 * (step % 3).astype(jnp.float32), 0.0)
+            reward = reward + jnp.where(sub_done, 1.0, 0.0)
+            done = done | sub_done
+
+        # Emitted info includes the final step; carried state resets on
+        # done (ImpalaStream.step, envs/core.py).
+        emitted_return = state.episode_return + reward
+        emitted_step = state.episode_step + 1
+        # Auto-reset: new episode, step 0, observation is its first frame
+        # built with action=0 (StreamAdapter.step -> FakeEnv.reset).
+        new_episode = state.episode + done.astype(jnp.int32)
+        new_step = jnp.where(done, 0, step)
+        obs_action = jnp.where(done, 0, action)
+        new_state = DeviceEnvState(
+            seed=state.seed,
+            episode=new_episode,
+            step=new_step,
+            episode_return=jnp.where(done, 0.0, emitted_return),
+            episode_step=jnp.where(done, 0, emitted_step),
+        )
+        output = StepOutput(
+            reward=reward,
+            info=StepOutputInfo(
+                episode_return=emitted_return,
+                episode_step=emitted_step),
+            done=done,
+            observation=Observation(
+                frame=self._frame(state.seed, new_episode, new_step,
+                                  obs_action),
+                instruction=None),
+        )
+        return new_state, output

@@ -1,4 +1,9 @@
 from scalable_agent_tpu.runtime.actor import ActorPool, VectorActor
+from scalable_agent_tpu.runtime.accum_actor import (
+    AccumPrograms,
+    AccumVectorActor,
+)
+from scalable_agent_tpu.runtime.ingraph import InGraphTrainer
 from scalable_agent_tpu.runtime.batcher import (
     BatcherClosedError,
     DynamicBatcher,

@@ -78,7 +78,6 @@ class AccumPrograms:
         self._action_shape = (batch,) if k == 1 else (batch, k)
         self._bufs_shape = dict(
             frame=(t1, batch) + self.frame_shape,
-            small=(t1,),  # [T+1, B] fields share this prefix
             action=(t1,) + self._action_shape,
             logits=(t1, batch, agent.num_logits),
         )

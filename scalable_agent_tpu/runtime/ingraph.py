@@ -1,0 +1,149 @@
+"""Fused in-graph training: rollout + IMPALA update as ONE device program.
+
+With an on-device environment (envs/device.py) the whole actor side —
+T agent-inference steps, T env transitions, trajectory assembly — plus
+the learner update compiles into a single jitted function.  A train step
+involves NO host↔device data movement at all (the host only dispatches),
+so chained dispatches stream to the device back-to-back; metrics are
+fetched on whatever cadence the caller wants.
+
+Per-update semantics match the host pipeline:
+
+- Trajectory layout is the reference's T+1 overlap layout (first entry of
+  unroll k+1 == last entry of unroll k, reference: experiment.py:311-321)
+  via the rollout carry.
+- The rollout runs under the params of the CURRENT state, i.e. zero
+  policy lag.  The host pipeline has >= 1 update of lag (the reference's
+  queue + staging design, experiment.py:531,587-597); V-trace corrects
+  for the behaviour/target gap in both cases, so this only shifts where
+  on the on/off-policy spectrum the data sits.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.models.agent import (
+    ImpalaAgent,
+    actor_step,
+    initial_state,
+)
+from scalable_agent_tpu.runtime.learner import Learner, Trajectory
+from scalable_agent_tpu.types import AgentOutput, AgentState
+
+
+class RolloutCarry(NamedTuple):
+    """Everything that flows from one unroll into the next, all [B]."""
+
+    env_state: object
+    env_output: object  # StepOutput
+    agent_output: AgentOutput
+    core_state: AgentState
+
+
+def _stack_first(first, seq):
+    """[B] entry + [T, B] sequence -> [T+1, B]."""
+    return jax.tree_util.tree_map(
+        lambda f, r: None if f is None else jnp.concatenate(
+            [f[None], r], axis=0),
+        first, seq, is_leaf=lambda x: x is None)
+
+
+class InGraphTrainer:
+    """Owns the fused (rollout + update) jitted step for a device env.
+
+    ``env`` must expose ``initial(seeds) -> (env_state, StepOutput[B])``
+    and ``step(env_state, action) -> (env_state, StepOutput[B])`` as pure
+    jnp functions (see envs/device.DeviceFakeEnv).
+    """
+
+    def __init__(
+        self,
+        agent: ImpalaAgent,
+        learner: Learner,
+        env,
+        unroll_length: int,
+        batch: int,
+        seed: int = 0,
+    ):
+        self._agent = agent
+        self._learner = learner
+        self._env = env
+        self._unroll_length = unroll_length
+        self._batch = batch
+        self._seed = int(seed)
+        self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
+
+    # -- initialization ----------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Tuple[object, RolloutCarry]:
+        """(TrainState, RolloutCarry) ready for ``train_step``."""
+        seeds = np.arange(self._batch, dtype=np.int32) + self._seed
+        env_state, env_output = self._env.initial(seeds)
+        agent_output = AgentOutput(
+            action=jnp.asarray(self._agent.zero_actions(self._batch)),
+            policy_logits=jnp.zeros(
+                (self._batch, self._agent.num_logits), jnp.float32),
+            baseline=jnp.zeros((self._batch,), jnp.float32),
+        )
+        core_state = initial_state(self._batch, self._agent.core_size)
+        carry = RolloutCarry(env_state, env_output, agent_output,
+                             core_state)
+        example = Trajectory(
+            agent_state=core_state,
+            env_outputs=_stack_first(
+                env_output,
+                jax.tree_util.tree_map(
+                    lambda x: None if x is None else x[None],
+                    env_output, is_leaf=lambda x: x is None)),
+            agent_outputs=_stack_first(
+                agent_output,
+                jax.tree_util.tree_map(
+                    lambda x: None if x is None else x[None],
+                    agent_output, is_leaf=lambda x: x is None)),
+        )
+        state = self._learner.init(rng, example)
+        return state, carry
+
+    # -- the fused program -------------------------------------------------
+
+    def _rollout(self, params, carry: RolloutCarry, rng):
+        agent, env = self._agent, self._env
+
+        def scan_fn(c, t):
+            out, core = actor_step(
+                agent, params, jax.random.fold_in(rng, t),
+                c.agent_output.action, c.env_output, c.core_state)
+            env_state, env_output = env.step(c.env_state, out.action)
+            return RolloutCarry(env_state, env_output, out, core), (
+                env_output, out)
+
+        new_carry, (env_seq, agent_seq) = jax.lax.scan(
+            scan_fn, carry, jnp.arange(self._unroll_length))
+        trajectory = Trajectory(
+            agent_state=carry.core_state,
+            env_outputs=_stack_first(carry.env_output, env_seq),
+            agent_outputs=_stack_first(carry.agent_output, agent_seq),
+        )
+        return trajectory, new_carry
+
+    def _fused(self, state, carry: RolloutCarry, counter):
+        rng = jax.random.fold_in(
+            jax.random.key(self._seed), counter)
+        trajectory, new_carry = self._rollout(state.params, carry, rng)
+        new_state, metrics = self._learner._update_impl(state, trajectory)
+        return new_state, new_carry, metrics
+
+    # -- host loop ---------------------------------------------------------
+
+    def run(self, state, carry, num_updates: int, counter_start: int = 0):
+        """Dispatch ``num_updates`` chained fused steps WITHOUT any host
+        synchronization; the caller decides when to fetch metrics (e.g.
+        ``float(np.asarray(metrics['total_loss']))``)."""
+        metrics = None
+        for i in range(num_updates):
+            state, carry, metrics = self.train_step(
+                state, carry, np.int32(counter_start + i))
+        return state, carry, metrics

@@ -1,0 +1,126 @@
+"""On-device env (envs/device.py) + fused in-graph trainer.
+
+The device mirror must be transition-exact against the host stack
+``ImpalaStream(StreamAdapter(FakeEnv))`` — frames, rewards, dones,
+episode accounting — across episode boundaries, action repeats, and
+length jitter.  The fused trainer must train (finite losses, exact frame
+accounting) with zero per-step host involvement.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs.core import ImpalaStream, StreamAdapter
+from scalable_agent_tpu.envs.device import DeviceEnvState, DeviceFakeEnv
+from scalable_agent_tpu.envs.fake import FakeEnv
+from scalable_agent_tpu.models import ImpalaAgent
+from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+from scalable_agent_tpu.runtime.ingraph import InGraphTrainer
+
+H = W = 12
+NUM_ACTIONS = 4
+
+
+def host_streams(seeds, episode_length, jitter, repeats):
+    streams = []
+    for s in seeds:
+        env = FakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
+                      episode_length=episode_length, length_jitter=jitter,
+                      seed=s, num_action_repeats=repeats)
+        streams.append(ImpalaStream(StreamAdapter(env)))
+    return streams
+
+
+@pytest.mark.parametrize("repeats,jitter", [(1, 0), (4, 0), (4, 3)])
+def test_device_env_mirrors_host_stack(repeats, jitter):
+    seeds = [0, 3, 11]
+    episode_length = 5
+    dev = DeviceFakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
+                        episode_length=episode_length,
+                        length_jitter=jitter,
+                        num_action_repeats=repeats)
+    streams = host_streams(seeds, episode_length, jitter, repeats)
+    state, out = dev.initial(np.asarray(seeds, np.int32))
+    host_outs = [s.initial() for s in streams]
+    step = jax.jit(dev.step)
+
+    rng = np.random.default_rng(0)
+    for t in range(40):
+        for i, h in enumerate(host_outs):
+            np.testing.assert_array_equal(
+                np.asarray(out.observation.frame[i]),
+                np.asarray(h.observation.frame),
+                err_msg=f"frame mismatch env {i} step {t}")
+            assert bool(out.done[i]) == bool(h.done), (i, t)
+            np.testing.assert_allclose(
+                float(out.reward[i]), float(h.reward), rtol=1e-6)
+            np.testing.assert_allclose(
+                float(out.info.episode_return[i]),
+                float(h.info.episode_return), rtol=1e-6)
+            assert int(out.info.episode_step[i]) == int(
+                h.info.episode_step), (i, t)
+        actions = rng.integers(0, NUM_ACTIONS, size=len(seeds))
+        state, out = step(state, jnp.asarray(actions, jnp.int32))
+        host_outs = [s.step(int(a)) for s, a in zip(streams, actions)]
+    for s in streams:
+        s.close()
+
+
+def test_device_env_rejects_overflow_seeds():
+    dev = DeviceFakeEnv(height=H, width=W, length_jitter=2)
+    with pytest.raises(ValueError, match="seeds must stay below"):
+        dev.initial(np.asarray([10**7], np.int32))
+
+
+class TestInGraphTrainer:
+    T = 5
+    B = 4
+
+    def make(self):
+        agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+        mesh = make_mesh(MeshSpec(data=1, model=1),
+                         devices=jax.devices()[:1])
+        learner = Learner(agent, LearnerHyperparams(
+            total_environment_frames=1e6), mesh,
+            frames_per_update=self.T * self.B)
+        env = DeviceFakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
+                            episode_length=7)
+        return InGraphTrainer(agent, learner, env, self.T, self.B, seed=5)
+
+    def test_fused_training_runs_and_counts_frames(self):
+        trainer = self.make()
+        state, carry = trainer.init(jax.random.key(0))
+        state, carry, metrics = trainer.run(state, carry, 4)
+        assert np.isfinite(float(np.asarray(metrics["total_loss"])))
+        assert float(np.asarray(metrics["env_frames"])) == (
+            4 * self.T * self.B)
+
+    def test_deterministic(self):
+        t1 = self.make()
+        s1, c1 = t1.init(jax.random.key(0))
+        s1, c1, m1 = t1.run(s1, c1, 3)
+        t2 = self.make()
+        s2, c2 = t2.init(jax.random.key(0))
+        s2, c2, m2 = t2.run(s2, c2, 3)
+        np.testing.assert_allclose(
+            float(np.asarray(m1["total_loss"])),
+            float(np.asarray(m2["total_loss"])), rtol=1e-6)
+
+    def test_unroll_overlap_layout(self):
+        """Entry 0 of the rollout == the carried previous last entry."""
+        trainer = self.make()
+        state, carry = trainer.init(jax.random.key(0))
+        rng = jax.random.key(1)
+        traj1, carry2 = jax.jit(trainer._rollout)(
+            state.params, carry, rng)
+        traj2, _ = jax.jit(trainer._rollout)(
+            state.params, carry2, jax.random.key(2))
+        np.testing.assert_array_equal(
+            np.asarray(traj1.env_outputs.observation.frame[self.T]),
+            np.asarray(traj2.env_outputs.observation.frame[0]))
+        np.testing.assert_array_equal(
+            np.asarray(traj1.agent_outputs.action[self.T]),
+            np.asarray(traj2.agent_outputs.action[0]))
