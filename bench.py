@@ -233,13 +233,18 @@ def bench_link(diag):
         float(np.asarray(tiny(x)[0]))
     diag["link_rtt_ms"] = round((time.perf_counter() - t0) / 5 * 1e3, 2)
 
+    # Bandwidth is synchronized by VALUE-fetching a byte of each
+    # uploaded buffer — block_until_ready is unreliable on this backend
+    # (see _fetch_scalar).  The fetches add ~1 RTT, so this is a slight
+    # under-estimate (a lower bound, which is the honest direction).
     big = np.zeros((16 << 20,), np.uint8)
-    jax.device_put(big, d).block_until_ready()
+    float(np.asarray(jax.device_put(big, d)[0]))  # warm
     t0 = time.perf_counter()
-    for _ in range(2):
-        jax.device_put(big, d).block_until_ready()
-    dt = (time.perf_counter() - t0) / 2
-    diag["link_h2d_flat_mb_s"] = round(16.0 / dt, 0)
+    puts = [jax.device_put(big, d) for _ in range(4)]
+    for p in puts:
+        float(np.asarray(p[0]))
+    dt = time.perf_counter() - t0
+    diag["link_h2d_flat_mb_s"] = round(4 * 16.0 / dt, 0)
 
 
 def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
@@ -308,8 +313,12 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
             frame_spec, num_workers=workers_per_group)
         for g in range(num_groups)
     ]
+    # queue_capacity=2: bounds how many pre-measurement trajectories can
+    # sit buffered (a deep queue lets warm-up-era output leak into the
+    # timed window and inflate fps); 2 preserves the +1-lag overlap.
     pool = ActorPool(agent, groups, unroll_len,
-                     level_name="fake_benchmark", inference_mode="accum")
+                     level_name="fake_benchmark", inference_mode="accum",
+                     queue_capacity=2)
     pool.set_params(state.params)
     pool.start()
 
@@ -319,8 +328,11 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
     stop = threading.Event()
     thread = start_prefetch(pool, learner, staged, stop)
     try:
-        # Warm up: compiles + pipeline fill (first unrolls of all groups).
-        for _ in range(max(2, num_groups // 2)):
+        # Warm up past compiles AND the queue fill: drain one update per
+        # group plus the staged/queue buffers so the timed window starts
+        # at steady state (trajectories produced before t0 must not be
+        # counted inside it).
+        for _ in range(num_groups + 4):
             traj = staged.get(timeout=600)
             if isinstance(traj, Exception):
                 raise traj

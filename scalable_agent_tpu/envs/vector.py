@@ -63,6 +63,7 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
             returns = np.zeros((k,), np.float32)
             steps = np.zeros((k,), np.int32)
             instructions = []
+            measurements = []
             for i, stream in enumerate(streams):
                 out = step_of_stream(i, stream)
                 rewards[i] = out.reward
@@ -71,8 +72,10 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
                 steps[i] = out.info.episode_step
                 slab[first_index + i] = out.observation.frame
                 instructions.append(out.observation.instruction)
+                measurements.append(out.observation.measurements)
             return (rewards, dones, returns, steps,
-                    _maybe_stack(instructions))
+                    _maybe_stack(instructions),
+                    _maybe_stack(measurements))
 
         while True:
             request = conn.recv()
@@ -189,19 +192,25 @@ class MultiEnv:
         returns = np.zeros((self.num_envs,), np.float32)
         steps = np.zeros((self.num_envs,), np.int32)
         instructions = None
+        measurements = None
         errors = []
         for conn, sl in zip(self._conns, self._slices):
             ok, payload = conn.recv()
             if not ok:
                 errors.append(pickle.loads(payload))
                 continue
-            r, d, ret, st, instr = payload
+            r, d, ret, st, instr, meas = payload
             rewards[sl], dones[sl], returns[sl], steps[sl] = r, d, ret, st
             if instr is not None:
                 if instructions is None:
                     instructions = np.zeros(
                         (self.num_envs,) + instr.shape[1:], instr.dtype)
                 instructions[sl] = instr
+            if meas is not None:
+                if measurements is None:
+                    measurements = np.zeros(
+                        (self.num_envs,) + meas.shape[1:], meas.dtype)
+                measurements[sl] = meas
         if errors:
             raise errors[0]
         for i in np.nonzero(dones)[0]:
@@ -213,7 +222,8 @@ class MultiEnv:
             info=StepOutputInfo(episode_return=returns, episode_step=steps),
             done=dones,
             observation=Observation(
-                frame=self._slab.copy(), instruction=instructions),
+                frame=self._slab.copy(), instruction=instructions,
+                measurements=measurements),
         )
 
     def initial(self) -> StepOutput:

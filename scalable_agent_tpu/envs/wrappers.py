@@ -48,7 +48,12 @@ def _resize_frame(frame: np.ndarray, height: int, width: int) -> np.ndarray:
 
 class ObservationWrapper(Wrapper):
     """Base for wrappers that only rewrite observations: subclasses
-    implement ``_transform`` once and both reset/step stay consistent."""
+    implement ``_transform`` once and both reset/step stay consistent.
+
+    A ``None`` observation passes through untouched — lockstep
+    multiplayer envs emit (None, None, None, None) on non-update ticks
+    (reference: env_wrappers.py:240-242, doom_multiagent.py:207-208).
+    """
 
     def _transform(self, observation):
         raise NotImplementedError
@@ -58,6 +63,8 @@ class ObservationWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:
+            return obs, reward, done, info
         return self._transform(obs), reward, done, info
 
 
@@ -126,6 +133,8 @@ class FrameStackWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:  # lockstep multiplayer non-update tick
+            return obs, reward, done, info
         self._frames.append(obs.frame)
         return self._emit(obs), reward, done, info
 
@@ -145,6 +154,8 @@ class SkipFramesWrapper(Wrapper):
         obs = None
         for _ in range(self._skip):
             obs, reward, done, info = self.env.step(action)
+            if obs is None:  # lockstep multiplayer non-update tick
+                return obs, reward, done, info
             total_reward += float(reward)
             if done:
                 break
@@ -219,6 +230,8 @@ class RewardScalingWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:  # lockstep multiplayer non-update tick
+            return obs, reward, done, info
         return obs, np.float32(reward * self._scale), done, info
 
 
@@ -227,6 +240,8 @@ class ClipRewardWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:  # lockstep multiplayer non-update tick
+            return obs, reward, done, info
         return obs, np.float32(np.clip(reward, -1.0, 1.0)), done, info
 
 
@@ -261,6 +276,8 @@ class TimeLimitWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:  # lockstep multiplayer non-update tick
+            return obs, reward, done, info
         self._steps += 1
         if not done and self._steps >= self._this_limit:
             done = True
@@ -332,6 +349,8 @@ class RecordingWrapper(Wrapper):
 
     def step(self, action):
         obs, reward, done, info = self.env.step(action)
+        if obs is None:  # lockstep multiplayer non-update tick
+            return obs, reward, done, info
         self._frames.append(np.asarray(obs.frame))
         self._actions.append(action)
         self._rewards.append(reward)

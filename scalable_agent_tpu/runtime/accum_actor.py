@@ -222,10 +222,11 @@ class AccumVectorActor:
         return frame.reshape(-1)  # free view; MultiEnv hands a fresh copy
 
     def _upload(self, env_output: StepOutput):
-        if env_output.observation.instruction is not None:
+        if (env_output.observation.instruction is not None
+                or env_output.observation.measurements is not None):
             raise NotImplementedError(
-                "accum inference mode does not carry instructions yet; "
-                "use inference_mode='structural'")
+                "accum inference mode does not carry instructions or "
+                "measurements yet; use inference_mode='structural'")
         return (self._flat_frame(env_output),
                 _pack_env_fields(env_output))
 

@@ -28,10 +28,14 @@ class Observation(NamedTuple):
     string and hashes it in-graph (reference: experiment.py:123-146); strings
     cannot live on a TPU, so hashing happens host-side in
     ``models/instruction.py`` and the device only ever sees int32 ids.
+    ``measurements`` is an optional f32 vector of game-state scalars
+    (health/ammo/weapons — the Doom additional-input wrapper, reference:
+    envs/doom/wrappers/additional_input.py:7-96); None everywhere else.
     """
 
     frame: Any
     instruction: Optional[Any] = None
+    measurements: Optional[Any] = None
 
 
 class StepOutput(NamedTuple):

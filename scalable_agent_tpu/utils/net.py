@@ -1,0 +1,26 @@
+"""Network helpers for multiplayer simulators.
+
+(reference: utils/network.py:6-15 — the UDP port probe VizDoom
+multiplayer games use to pick their host ports)
+"""
+
+import socket
+
+
+def is_udp_port_available(port: int) -> bool:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+
+
+def find_available_udp_port(start_port: int, increment: int = 1000) -> int:
+    """First available UDP port in start + k*increment (reference:
+    envs/doom/multiplayer/doom_multiagent.py:16-22)."""
+    port = start_port
+    while port < 65535 and not is_udp_port_available(port):
+        port += increment
+    return port

@@ -1,0 +1,183 @@
+"""Fake ``vizdoom`` module for hermetic Doom-layer tests.
+
+A deterministic stand-in for the VizDoom engine exposing exactly the API
+surface scalable_agent_tpu.envs.doom consumes (DoomGame, ScreenResolution,
+Mode).  Lives as a real module file (not a monkeypatch) so spawned env
+worker subprocesses can import it when the tests put this directory on
+PYTHONPATH.
+
+Game model: episodes last EPISODE_TICS engine tics; frames are CHW uint8
+filled with a (episode, tic) pattern; per-tic reward is (tic % 5) * 0.1;
+game variables are deterministic functions of (name, tic) including a
+declining HEALTH and growing FRAGCOUNT so the shaping/stats wrappers have
+real deltas to chew on.  Multiplayer args (-host/-join) are recorded and
+init always succeeds; set_action/advance_action implement the lockstep
+path.
+"""
+
+import os
+import re
+
+import numpy as np
+
+EPISODE_TICS = int(os.environ.get("FAKE_VIZDOOM_EPISODE_TICS", "64"))
+
+
+class _State:
+    def __init__(self, screen_buffer, game_variables):
+        self.screen_buffer = screen_buffer
+        self.game_variables = game_variables
+
+
+class ScreenResolution:
+    pass
+
+
+for _res in ("160X120", "200X125", "200X150", "256X144", "320X240",
+             "640X480", "800X600", "1280X720"):
+    setattr(ScreenResolution, f"RES_{_res}", f"RES_{_res}")
+
+
+class Mode:
+    PLAYER = "PLAYER"
+    ASYNC_PLAYER = "ASYNC_PLAYER"
+
+
+def _variable_value(name: str, tic: int) -> float:
+    if name == "HEALTH":
+        return max(0.0, 100.0 - tic)
+    if name == "ARMOR":
+        return float(tic % 7)
+    if name == "FRAGCOUNT":
+        return float(tic // 8)
+    if name == "DEATHCOUNT":
+        return float(tic // 16)
+    if name == "HITCOUNT":
+        return float(tic // 4)
+    if name == "DAMAGECOUNT":
+        return float(3 * (tic // 4))
+    if name == "SELECTED_WEAPON":
+        return 2.0
+    if name == "SELECTED_WEAPON_AMMO":
+        return max(0.0, 40.0 - tic // 2)
+    if name == "ATTACK_READY":
+        return float(tic % 2)
+    if name == "PLAYER_NUM":
+        return 1.0
+    if name == "PLAYER_COUNT":
+        return 2.0
+    if name.startswith("PLAYER") and name.endswith("_FRAGCOUNT"):
+        player = int(re.match(r"PLAYER(\d+)_", name).group(1))
+        return float(tic // 8 - player)
+    if name == "DEAD":
+        return 0.0
+    return float(abs(hash(name)) % 10)
+
+
+class DoomGame:
+    def __init__(self):
+        self.config_path = None
+        self.variable_names = []
+        self.args = []
+        self.commands = []
+        self.seed = 0
+        self.width, self.height = 320, 240
+        self.window_visible = None
+        self.mode = None
+        self.initialized = False
+        self.closed = False
+        self.tic = 0
+        self.episode = 0
+        self._last_reward = 0.0
+        self._pending_action = None
+
+    # -- config ------------------------------------------------------------
+
+    def load_config(self, path):
+        if not os.path.isfile(path):
+            raise RuntimeError(f"config file {path} not found")
+        self.config_path = path
+        pattern = re.compile(r"available_game_variables\s*=\s*\{(.*)\}")
+        with open(path) as f:
+            for line in f:
+                match = pattern.match(line.strip())
+                if match:
+                    self.variable_names = match.group(1).split()
+                    break
+
+    def set_screen_resolution(self, res):
+        w, h = str(res).replace("RES_", "").split("X")
+        self.width, self.height = int(w), int(h)
+
+    def set_seed(self, seed):
+        self.seed = int(seed)
+
+    def set_window_visible(self, visible):
+        self.window_visible = bool(visible)
+
+    def set_mode(self, mode):
+        self.mode = mode
+
+    def add_game_args(self, args):
+        self.args.append(args)
+
+    def init(self):
+        self.initialized = True
+        self.tic = 0
+
+    # -- episode -----------------------------------------------------------
+
+    def new_episode(self, demo_path=None):
+        self.tic = 0
+        self.episode += 1
+        self.demo_path = demo_path
+
+    def is_episode_finished(self):
+        return self.tic >= EPISODE_TICS
+
+    def _frame(self):
+        base = (self.episode * 31 + self.tic * 7) % 251
+        frame = np.full((3, self.height, self.width), base, np.uint8)
+        frame[0, 0, 0] = self.tic % 256
+        return frame
+
+    def get_state(self):
+        if self.is_episode_finished():
+            return None
+        variables = [_variable_value(name, self.tic)
+                     for name in self.variable_names]
+        return _State(self._frame(), variables)
+
+    # -- stepping ----------------------------------------------------------
+
+    def _advance(self, tics):
+        reward = 0.0
+        for _ in range(tics):
+            if self.is_episode_finished():
+                break
+            self.tic += 1
+            reward += (self.tic % 5) * 0.1
+        self._last_reward = reward
+        return reward
+
+    def make_action(self, buttons, skip=1):
+        assert isinstance(buttons, (list, tuple)), buttons
+        assert all(isinstance(b, (int, float)) for b in buttons), buttons
+        self._pending_action = list(buttons)
+        return self._advance(skip)
+
+    def set_action(self, buttons):
+        self._pending_action = list(buttons)
+
+    def advance_action(self, tics=1, update_state=True):
+        self._advance(tics)
+
+    def get_last_reward(self):
+        return self._last_reward
+
+    def send_game_command(self, command):
+        self.commands.append(command)
+
+    def close(self):
+        self.closed = True
+        self.initialized = False

@@ -1,0 +1,346 @@
+"""Doom env layer tests — hermetic via tests/fakes/vizdoom.py.
+
+Covers the reference behaviors the layer reproduces: composite action
+conversion (one-hot with noop-0, Discretized grids, Box delta scaling),
+the VizdoomEnv-equivalent core (lazy init, black terminal screen, game
+variables, stale-counter workaround), the DoomSpec wrapper pipeline
+(battle measurements + shaping, benchmark convention), multiplayer
+host/join + bots + lockstep, the multi-agent aggregator feeding the
+ActorPool, and a real (tiny) driver train run on doom_benchmark.
+"""
+
+import functools
+import os
+import sys
+
+import numpy as np
+import pytest
+
+FAKES_DIR = os.path.join(os.path.dirname(__file__), "fakes")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def fake_vizdoom(tmp_path_factory):
+    """Shadow ``vizdoom`` with the deterministic fake and generate
+    scenario .cfg files (sys.path is inherited by spawned env worker
+    subprocesses; DOOM_SCENARIOS_DIR rides os.environ)."""
+    scenarios = tmp_path_factory.mktemp("scenarios")
+    single = ("HEALTH ARMOR SELECTED_WEAPON SELECTED_WEAPON_AMMO "
+              "FRAGCOUNT DEATHCOUNT HITCOUNT DAMAGECOUNT DEAD")
+    multi = single + " PLAYER_NUM PLAYER_COUNT PLAYER1_FRAGCOUNT PLAYER2_FRAGCOUNT"
+    cfgs = {
+        "basic.cfg": single,
+        "battle.cfg": single,
+        "battle_continuous_turning.cfg": single,
+        "health_gathering.cfg": "HEALTH",
+        "two_colors_easy.cfg": "HEALTH",
+        "ssl2.cfg": multi,
+        "dwango5_dm_continuous_weap.cfg": multi,
+    }
+    for name, variables in cfgs.items():
+        (scenarios / name).write_text(
+            "# fake scenario for hermetic tests\n"
+            f"doom_scenario_path = {name.replace('.cfg', '.wad')}\n"
+            f"available_game_variables = {{ {variables} }}\n")
+    sys.path.insert(0, FAKES_DIR)
+    os.environ["DOOM_SCENARIOS_DIR"] = str(scenarios)
+    sys.modules.pop("vizdoom", None)
+    yield
+    sys.path.remove(FAKES_DIR)
+    sys.modules.pop("vizdoom", None)
+    os.environ.pop("DOOM_SCENARIOS_DIR", None)
+
+
+class TestActionSpaces:
+    def test_variant_shapes(self):
+        from scalable_agent_tpu.envs import doom as d
+        from scalable_agent_tpu.envs.spaces import (
+            calc_num_actions, calc_num_logits)
+
+        assert calc_num_actions(d.doom_action_space_basic()) == 2
+        assert calc_num_logits(d.doom_action_space_basic()) == 6
+        assert calc_num_actions(
+            d.doom_action_space_discretized_no_weap()) == 5
+        assert calc_num_logits(
+            d.doom_action_space_discretized_no_weap()) == 3 + 3 + 2 + 2 + 11
+        full = d.doom_action_space_full_discretized(with_use=True)
+        assert calc_num_actions(full) == 7
+        assert calc_num_logits(full) == 3 + 3 + 8 + 2 + 2 + 2 + 21
+
+    def test_convert_one_hot_noop(self):
+        from scalable_agent_tpu.envs.doom.core import convert_actions
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+
+        space = doom_action_space_basic()
+        assert convert_actions(space, (0, 0)) == [0, 0, 0, 0]
+        assert convert_actions(space, (1, 2)) == [1, 0, 0, 1]
+
+    def test_convert_discretized_grid(self):
+        from scalable_agent_tpu.envs.doom.core import convert_actions
+        from scalable_agent_tpu.envs.doom import (
+            doom_action_space_discretized_no_weap)
+
+        space = doom_action_space_discretized_no_weap()
+        flat = convert_actions(space, (0, 0, 0, 0, 0))
+        assert flat[-1] == -10.0  # Discretized(11, -10, 10) index 0
+        flat = convert_actions(space, (0, 0, 0, 0, 10))
+        assert flat[-1] == 10.0
+        flat = convert_actions(space, (0, 0, 0, 0, 5))
+        assert flat[-1] == 0.0
+
+    def test_convert_box_scaling(self):
+        from scalable_agent_tpu.envs.doom.core import convert_actions
+        from scalable_agent_tpu.envs.doom import doom_action_space
+
+        space = doom_action_space()
+        flat = convert_actions(
+            space, (0, 0, 0, 0, 0, np.asarray([0.5], np.float32)))
+        assert flat[-1] == pytest.approx(0.5 * 7.5)  # delta scaling
+
+    def test_convert_plain_discrete(self):
+        from scalable_agent_tpu.envs.doom.core import convert_actions
+        from scalable_agent_tpu.envs.spaces import Discrete
+
+        assert convert_actions(Discrete(9), 3) == [0, 0, 1, 0, 0, 0, 0, 0]
+
+
+class TestDoomEnvCore:
+    def test_benchmark_env_lifecycle(self):
+        from scalable_agent_tpu.envs import create_env
+
+        env = create_env("doom_benchmark", num_action_repeats=4)
+        try:
+            assert env.observation_spec.frame.shape == (72, 128, 3)
+            obs = env.reset()
+            assert obs.frame.shape == (72, 128, 3)
+            total_steps = 0
+            done = False
+            while not done:
+                obs, reward, done, info = env.step(3)
+                total_steps += 1
+                assert isinstance(float(reward), float)
+            # 64 fake tics / 4-skip
+            assert total_steps == 16
+            assert "HEALTH" in info
+            # terminal observation is the black screen
+            assert not obs.frame.any()
+        finally:
+            env.close()
+
+    def test_game_variable_info_and_bug_workaround(self):
+        from scalable_agent_tpu.envs import create_env
+
+        env = create_env("doom_benchmark", num_action_repeats=4)
+        try:
+            env.reset()
+            _, _, done, info = env.step(0)
+            assert info["HEALTH"] == pytest.approx(100.0 - 4)
+            while not done:
+                _, _, done, info1 = env.step(0)
+            # Second episode: DEATHCOUNT/HITCOUNT/DAMAGECOUNT subtract
+            # the previous episode's final values (the VizDoom
+            # stale-variable workaround, reference doom_gym.py:310-319).
+            env.reset()
+            _, _, _, info2 = env.step(0)
+            raw_hit = 4 // 4  # fake: HITCOUNT = tic // 4 at tic 4
+            assert info2["HITCOUNT"] == pytest.approx(
+                raw_hit - info1["HITCOUNT"])
+        finally:
+            env.close()
+
+    def test_missing_scenario_errors_clearly(self):
+        from scalable_agent_tpu.envs.doom.core import resolve_scenario_path
+
+        with pytest.raises(FileNotFoundError, match="nope.cfg"):
+            resolve_scenario_path("nope.cfg")
+
+
+class TestDoomPipeline:
+    def test_battle_composite_pipeline(self):
+        from scalable_agent_tpu.envs import create_env
+        from scalable_agent_tpu.envs.spaces import TupleSpace
+
+        env = create_env("doom_battle", num_action_repeats=4)
+        try:
+            assert isinstance(env.action_space, TupleSpace)
+            obs = env.reset()
+            # measurements vector from DoomAdditionalInput (7 + 2*8)
+            assert obs.measurements.shape == (23,)
+            spec = env.observation_spec
+            assert spec.measurements.shape == (23,)
+            obs, reward, done, info = env.step((1, 0, 1, 0, 5))
+            assert obs.measurements[2] == pytest.approx(
+                info["HEALTH"] / 30.0)
+            assert "true_reward" not in info  # only set on done
+        finally:
+            env.close()
+
+    def test_battle_reward_shaping_applies(self):
+        from scalable_agent_tpu.envs import create_env
+
+        env = create_env("doom_battle", num_action_repeats=4)
+        try:
+            env.reset()
+            env.step((0, 0, 0, 0, 5))  # first step primes prev_vars
+            _, reward2, _, info = env.step((0, 0, 0, 0, 5))
+            # fake raw per-step reward at tics 5..8
+            raw = sum((t % 5) * 0.1 for t in (5, 6, 7, 8))
+            # HITCOUNT +1/step * 0.01, DAMAGECOUNT +3 * 0.003,
+            # HEALTH -4 * 0.003 (down-rate), ARMOR cycles mod 7
+            assert float(reward2) != pytest.approx(raw)
+        finally:
+            env.close()
+
+    def test_impala_stream_native_repeats(self):
+        from scalable_agent_tpu.envs import make_impala_stream
+
+        stream = make_impala_stream("doom_benchmark", seed=3,
+                                    num_action_repeats=4)
+        try:
+            stream.initial()
+            out = stream.step(1)
+            assert out.info.episode_step == 1
+            # 16 agent steps per 64-tic fake episode; episode accounting
+            # resets across the auto-reset boundary
+            for _ in range(15):
+                out = stream.step(1)
+            assert out.done
+        finally:
+            stream.close()
+
+
+class TestMultiplayer:
+    def test_bots_host_setup(self):
+        from scalable_agent_tpu.envs import create_env
+
+        env = create_env("doom_deathmatch_bots", num_action_repeats=4)
+        try:
+            env.reset()
+            game = env.unwrapped.game
+            assert any("-host 1" in a for a in game.args)
+            assert "removebots" in game.commands
+            assert sum(
+                1 for c in game.commands if c.startswith("addbot")) == 7
+            obs, reward, done, info = env.step((0, 0, 0, 0, 0, 10))
+            assert obs.measurements is not None
+        finally:
+            env.close()
+
+    def test_duel_lockstep_two_agents(self):
+        from scalable_agent_tpu.envs import create_env
+
+        env = create_env("doom_duel", num_action_repeats=4)
+        try:
+            assert env.num_agents == 2
+            obs = env.reset()
+            assert len(obs) == 2
+            action = (0, 0, 0, 0, 0, 0, 10)
+            obs, rewards, dones, infos = env.step([action, action])
+            assert len(obs) == len(rewards) == len(dones) == 2
+            assert not any(dones)
+            # 4-frameskip via lockstep: 3 silent ticks + 1 update tick
+            for _ in range(15):
+                obs, rewards, dones, infos = env.step([action, action])
+            assert all(dones)
+            # post-done observations come from the auto-reset
+            assert obs[0].frame.shape == (72, 128, 3)
+        finally:
+            env.close()
+
+    def test_host_and_join_args(self):
+        from scalable_agent_tpu.envs.doom.multiplayer import (
+            DoomMultiplayerEnv)
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+
+        host = DoomMultiplayerEnv(
+            doom_action_space_basic(), "ssl2.cfg", player_id=0,
+            num_agents=2, max_num_players=2, num_bots=0, port=40555)
+        join = DoomMultiplayerEnv(
+            doom_action_space_basic(), "ssl2.cfg", player_id=1,
+            num_agents=2, max_num_players=2, num_bots=0, port=40555)
+        try:
+            host.reset()
+            join.reset()
+            assert any("-host 2" in a for a in host.game.args)
+            assert any("-join 127.0.0.1:40555" in a
+                       for a in join.game.args)
+        finally:
+            host.close()
+            join.close()
+
+
+class TestAggregator:
+    def test_aggregator_feeds_actor_pool(self):
+        import jax
+
+        from scalable_agent_tpu.envs import create_env
+        from scalable_agent_tpu.envs.doom.multiplayer import (
+            MultiAgentVectorEnv)
+        from scalable_agent_tpu.models import ImpalaAgent
+        from scalable_agent_tpu.models import agent as agent_mod
+        from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+        from scalable_agent_tpu.runtime import (
+            ActorPool, Learner, LearnerHyperparams, Trajectory)
+
+        T = 4
+        vec = MultiAgentVectorEnv([
+            functools.partial(create_env, "doom_duel",
+                              num_action_repeats=4)
+            for _ in range(2)
+        ])
+        assert vec.num_envs == 4
+        spec = create_env("doom_duel", num_action_repeats=4)
+        action_space = spec.action_space  # cheap: no games started
+        spec.close()
+        agent = ImpalaAgent(action_space=action_space)
+        pool = ActorPool(agent, [vec], unroll_length=T, seed=5)
+        out0 = vec.initial()
+        params = agent.init(
+            jax.random.key(0),
+            np.zeros((1, 4, 7), np.int32),
+            jax.tree_util.tree_map(
+                lambda x: None if x is None else np.asarray(x)[None],
+                out0, is_leaf=lambda x: x is None),
+            agent_mod.initial_state(4))
+        pool.set_params(params)
+        pool.start()
+        try:
+            out = pool.get_trajectory(timeout=120)
+            assert out.agent_outputs.action.shape == (T + 1, 4, 7)
+            mesh = make_mesh(MeshSpec(data=4, model=1),
+                             devices=jax.devices()[:4])
+            learner = Learner(agent, LearnerHyperparams(), mesh,
+                              frames_per_update=T * 4 * 4)
+            traj = Trajectory(out.agent_state, out.env_outputs,
+                              out.agent_outputs)
+            state = learner.init(jax.random.key(1), traj)
+            state, metrics = learner.update(
+                state, learner.put_trajectory(traj))
+            assert np.isfinite(float(np.asarray(metrics["total_loss"])))
+        finally:
+            pool.stop()
+
+
+class TestDriverDoom:
+    def test_driver_trains_on_doom_benchmark(self, tmp_path):
+        """VERDICT r2 done-criterion: the driver constructs and trains
+        --level_name=doom_benchmark under the fake simulator."""
+        from scalable_agent_tpu.config import Config
+        from scalable_agent_tpu.driver import train
+
+        config = Config(
+            mode="train",
+            logdir=str(tmp_path / "logs"),
+            level_name="doom_benchmark",
+            num_actors=4,
+            batch_size=2,
+            unroll_length=3,
+            num_action_repeats=4,
+            num_env_workers_per_group=2,
+            total_environment_frames=3 * 2 * 3 * 4,  # 3 updates
+            compute_dtype="float32",
+            checkpoint_interval_s=1e9,
+        )
+        metrics = train(config)
+        assert np.isfinite(metrics["total_loss"])
+        assert metrics["env_frames"] == config.total_environment_frames
