@@ -50,6 +50,8 @@ class Config:
 
     # -- eval (reference: experiment.py:57-58)
     test_num_episodes: int = 10
+    test_batch_size: int = 8  # parallel eval envs per level
+    test_num_workers: int = 2  # env worker processes per eval fleet
 
     # -- TPU-native knobs (no reference equivalent)
     torso_type: str = "shallow"  # shallow | resnet
@@ -60,6 +62,12 @@ class Config:
     # inference.  See driver.make_env_groups.)
     mesh_data: int = 0  # 0 = all devices
     mesh_model: int = 1
+    # Multi-host (DCN) distribution — empty/0/-1 = single process.
+    # (role of the reference's ClusterSpec + --job_name/--task flags,
+    # experiment.py:497-512)
+    distributed_coordinator: str = ""  # e.g. "10.0.0.1:8476"
+    distributed_num_processes: int = 0
+    distributed_process_id: int = -1
     # Actor inference: "structural" (one jitted step per group) or
     # "service" (C++ dynamic batcher co-batches groups into one call —
     # the reference's architecture, dynamic_batching.py + batcher.cc).
@@ -72,8 +80,18 @@ class Config:
     # -------------------------------------------------------------------
 
     def group_size(self) -> int:
-        """Envs per actor group == learner batch (minimum slice layout)."""
-        return self.batch_size
+        """Envs per actor group == this host's share of the learner
+        batch (minimum slice layout; ``batch_size`` is GLOBAL in
+        multi-host runs, matching the reference's one learner batch fed
+        by all actors, experiment.py:576)."""
+        import jax
+
+        processes = jax.process_count()
+        if self.batch_size % processes:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"{processes} processes")
+        return self.batch_size // processes
 
     def frames_per_update(self) -> int:
         """(reference: experiment.py:417-420)"""

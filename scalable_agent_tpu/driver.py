@@ -14,6 +14,8 @@ Run:
 import argparse
 import dataclasses
 import functools
+import json
+import os
 import queue as queue_lib
 import threading
 import time
@@ -52,14 +54,14 @@ from scalable_agent_tpu.types import (
 from scalable_agent_tpu.utils import Timing, log
 
 
-def env_kwargs(config: Config) -> dict:
+def env_kwargs(config: Config, name: Optional[str] = None) -> dict:
     """Per-family constructor kwargs (the reference threads width/height/
     etc. through create_environment, experiment.py:430-459)."""
-    name = config.level_name
+    name = name or config.level_name
     if name.startswith(("fake_", "dmlab_")):
         return {"height": config.height, "width": config.width,
                 "with_instruction": config.use_instruction}
-    if name.startswith(("atari_", "gym_")):
+    if name.startswith(("atari_", "gym_", "doom_")):
         return {"height": config.height, "width": config.width}
     return {}
 
@@ -184,10 +186,38 @@ def start_prefetch(pool, learner, staged: queue_lib.Queue,
     return thread
 
 
+def _host_scalar(x) -> float:
+    """Scalar metric -> host float, multi-host safe (replicated global
+    arrays are not fully addressable; the local copy is)."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        return float(np.asarray(x.addressable_shards[0].data))
+    return float(np.asarray(x))
+
+
 def train(config: Config) -> Dict[str, float]:
-    """Train until total_environment_frames.  Returns final metrics."""
+    """Train until total_environment_frames.  Returns final metrics.
+
+    Multi-host: run the SAME command on every host with
+    --distributed_coordinator/--distributed_num_processes/
+    --distributed_process_id set (or JAX_* env vars).  Every process
+    runs its own actor pool contributing 1/P of each global batch; the
+    learner update is one SPMD program over the global device mesh
+    (parallel/distributed.py; role of the reference's learner+actor
+    jobs, experiment.py:497-512)."""
+    from scalable_agent_tpu.parallel.distributed import (
+        initialize_distributed,
+        is_coordinator,
+    )
+
+    initialize_distributed(
+        config.distributed_coordinator or None,
+        config.distributed_num_processes or None,
+        config.distributed_process_id
+        if config.distributed_process_id >= 0 else None)
+
     config = apply_env_overrides(config)
-    config.save()
+    if is_coordinator():
+        config.save()
     observation_spec, action_space = probe_env(config)
     agent = build_agent(config, action_space)
 
@@ -226,9 +256,9 @@ def train(config: Config) -> Dict[str, float]:
     restored = ckpt.restore(target=state)
     if restored is not None:
         start_updates, host_state = restored
-        state = jax.device_put(host_state, learner._replicated)
+        state = learner.place_state(host_state)
         log.info("restored checkpoint at update %d (%.0f frames)",
-                 start_updates, float(np.asarray(state.env_frames)))
+                 start_updates, _host_scalar(state.env_frames))
     else:
         start_updates = 0
 
@@ -246,7 +276,9 @@ def train(config: Config) -> Dict[str, float]:
     prefetch_stop = threading.Event()
     prefetch_thread = start_prefetch(pool, learner, staged, prefetch_stop)
 
-    writer = MetricsWriter(config.logdir)
+    from scalable_agent_tpu.parallel.distributed import is_coordinator
+
+    writer = MetricsWriter(config.logdir) if is_coordinator() else None
     timing = Timing()
     updates = start_updates
     frames_per_update = config.frames_per_update()
@@ -254,7 +286,7 @@ def train(config: Config) -> Dict[str, float]:
     # is authoritative — recomputing updates*frames_per_update from the
     # CURRENT config would silently disagree if batch_size/unroll_length/
     # num_action_repeats changed between runs.
-    frames = float(np.asarray(state.env_frames))
+    frames = _host_scalar(state.env_frames)
     last_log = time.monotonic()
     frames_at_last_log = frames
     metrics = {}
@@ -272,7 +304,7 @@ def train(config: Config) -> Dict[str, float]:
 
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:
-                host_metrics = {k: float(np.asarray(v))
+                host_metrics = {k: _host_scalar(v)
                                 for k, v in metrics.items()}
                 fps = (frames - frames_at_last_log) / (now - last_log)
                 host_metrics["fps"] = fps
@@ -283,7 +315,8 @@ def train(config: Config) -> Dict[str, float]:
                     host_metrics["episode_frames"] = float(
                         np.mean([l for _, l in stats])
                         * config.num_action_repeats)
-                writer.write(updates, host_metrics)
+                if writer is not None:
+                    writer.write(updates, host_metrics)
                 log.info(
                     "update %d frames %.3g fps %.0f loss %.3f return %s | %s",
                     updates, frames, fps,
@@ -297,18 +330,75 @@ def train(config: Config) -> Dict[str, float]:
         prefetch_stop.set()
         pool.stop()
         prefetch_thread.join(timeout=5)
-        writer.close()
+        if writer is not None:
+            writer.close()
         ckpt.close()
-    return {k: float(np.asarray(v)) for k, v in metrics.items()}
+        if jax.process_count() > 1:
+            # No process may exit (tearing down the coordination
+            # service) until every process finished its checkpoint IO.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("train_exit")
+    return {k: _host_scalar(v) for k, v in metrics.items()}
+
+
+def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
+                level_name: str, frame_spec: TensorSpec,
+                num_episodes: int) -> List[float]:
+    """Collect ``num_episodes`` returns with a BATCHED eval fleet: a
+    MultiEnv of ``test_batch_size`` envs stepped under one jitted [B]
+    inference call (the reference evaluates batch-1 synchronously,
+    experiment.py:691-701 — this is the same protocol at fleet width)."""
+    batch = max(1, min(num_episodes, config.test_batch_size))
+    fns = [
+        functools.partial(
+            make_impala_stream, level_name,
+            seed=config.seed * 977 + 131 * i,
+            num_action_repeats=config.num_action_repeats,
+            **env_kwargs(config, level_name))
+        for i in range(batch)
+    ]
+    envs = MultiEnv(fns, frame_spec,
+                    num_workers=min(batch, config.test_num_workers))
+    returns: List[float] = []
+    try:
+        output = envs.initial()
+        core_state = initial_state(batch, agent.core_size)
+        action = np.asarray(agent.zero_actions(batch))
+        rng = jax.random.key(config.seed)
+        step_index = 0
+        while len(returns) < num_episodes:
+            step_index += 1
+            agent_out, core_state = step_fn(
+                params, jax.random.fold_in(rng, step_index), action,
+                output, core_state)
+            action = np.asarray(agent_out.action)
+            envs.step_send(action)
+            output = envs.step_recv()
+            for i in np.nonzero(np.asarray(output.done))[0]:
+                if int(output.info.episode_step[i]) > 0:
+                    returns.append(float(output.info.episode_return[i]))
+    finally:
+        envs.close()
+    return returns[:num_episodes]
 
 
 def test(config: Config) -> Dict[str, List[float]]:
-    """Evaluate a checkpoint for test_num_episodes per level.
+    """Evaluate a checkpoint: test_num_episodes per level, batched.
 
-    (reference: experiment.py:675-708)
+    ``--level_name=dmlab30`` evaluates the FULL suite (every DMLab-30
+    test variant) and emits capped/uncapped human-normalized suite
+    scores to the log and ``<logdir>/eval_scores.json``
+    (reference: experiment.py:675-708 + :716-717).
     """
     config = apply_env_overrides(config)
-    observation_spec, action_space = probe_env(config)
+    suite = config.level_name == "dmlab30"
+    level_names = ([f"dmlab_{name}" for name in dmlab30.TEST_LEVELS]
+                   if suite else [config.level_name])
+
+    probe_config = (dataclasses.replace(config, level_name=level_names[0])
+                    if suite else config)
+    observation_spec, action_space = probe_env(probe_config)
     agent = build_agent(config, action_space)
 
     # Restore against a structure template so optimizer-state NamedTuples
@@ -319,53 +409,55 @@ def test(config: Config) -> Dict[str, List[float]]:
     learner = Learner(agent, hp, mesh, config.frames_per_update())
     template = learner.init(
         jax.random.key(0),
-        zero_trajectory(config, observation_spec, agent))
+        zero_trajectory(probe_config, observation_spec, agent))
     ckpt = CheckpointManager(config.logdir)
     restored = ckpt.restore(target=template)
     if restored is None:
         raise FileNotFoundError(
             f"no checkpoint under {config.logdir}/checkpoints")
     _, host_state = restored
-    params = host_state.params
+    params = jax.device_put(host_state.params)
 
     step_fn = jax.jit(
         lambda params, rng, action, env_output, state: actor_step(
             agent, params, rng, action, env_output, state))
 
-    level_returns: Dict[str, List[float]] = {config.level_name: []}
-    stream = make_impala_stream(
-        config.level_name, seed=config.seed,
-        num_action_repeats=config.num_action_repeats, **env_kwargs(config))
-    try:
-        output = stream.initial()
-        core_state = initial_state(1, agent.core_size)
-        action = np.asarray(agent.zero_actions(1))
-        rng = jax.random.key(config.seed)
-        step_index = 0
-        while len(level_returns[config.level_name]) < config.test_num_episodes:
-            step_index += 1
-            batched = jax.tree_util.tree_map(
-                lambda x: None if x is None else np.asarray(x)[None],
-                output, is_leaf=lambda x: x is None)
-            agent_out, core_state = step_fn(
-                params, jax.random.fold_in(rng, step_index), action,
-                batched, core_state)
-            action = np.asarray(agent_out.action)
-            # action[0] is a scalar for Discrete, a [K] row for composites.
-            output = stream.step(action[0])
-            if output.done:
-                level_returns[config.level_name].append(
-                    float(output.info.episode_return))
-    finally:
-        stream.close()
+    level_returns: Dict[str, List[float]] = {}
+    for level_name in level_names:
+        returns = _eval_level(
+            config, agent, params, step_fn, level_name,
+            observation_spec.frame, config.test_num_episodes)
+        level_returns[level_name] = returns
+        log.info("level %s: mean return %.2f over %d episodes",
+                 level_name, float(np.mean(returns)), len(returns))
 
-    returns = level_returns[config.level_name]
-    log.info("level %s: mean return %.2f over %d episodes",
-             config.level_name, float(np.mean(returns)), len(returns))
-    if config.level_name in dmlab30.ALL_LEVELS:
+    if suite:
+        # Scoring keys are bare test-level names (reference:
+        # dmlab30.py:186-218).
+        by_level = {name[len("dmlab_"):]: r
+                    for name, r in level_returns.items()}
+        no_cap = dmlab30.compute_human_normalized_score(
+            by_level, per_level_cap=None)
+        cap_100 = dmlab30.compute_human_normalized_score(
+            by_level, per_level_cap=100.0)
+        log.info("suite score — no cap: %.2f  cap 100: %.2f",
+                 no_cap, cap_100)
+        scores_path = os.path.join(config.logdir, "eval_scores.json")
+        os.makedirs(config.logdir, exist_ok=True)
+        with open(scores_path, "w") as f:
+            json.dump({
+                "human_normalized_no_cap": no_cap,
+                "human_normalized_cap_100": cap_100,
+                "episodes_per_level": config.test_num_episodes,
+                "mean_returns": {k: float(np.mean(v))
+                                 for k, v in by_level.items()},
+            }, f, indent=2)
+        log.info("suite scores written to %s", scores_path)
+    elif config.level_name in dmlab30.ALL_LEVELS:
         # Single-level runs can't produce the full-suite score; log the
         # per-level normalized value (reference computes the suite mean,
         # experiment.py:703-708).
+        returns = level_returns[config.level_name]
         record = dmlab30.LEVELS.get(
             config.level_name,
             dmlab30._BY_TEST_NAME.get(config.level_name))

@@ -152,4 +152,9 @@ def assemble_doom_env(
         wrapped = wrapper_factory(wrapped, **kwargs)
     if spec.reward_scaling != 1.0:
         wrapped = RewardScalingWrapper(wrapped, spec.reward_scaling)
+    # Surface the base env's native frameskip on the OUTERMOST wrapper:
+    # make_impala_stream reads this attribute to avoid stacking a second
+    # SkipFramesWrapper on top of make_action's skip_frames (wrappers
+    # don't forward arbitrary attributes).
+    wrapped.native_action_repeats = env.native_action_repeats
     return wrapped

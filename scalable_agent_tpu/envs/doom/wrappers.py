@@ -227,7 +227,12 @@ class DoomAdditionalInput(Wrapper):
 class BotDifficultyWrapper(Wrapper):
     """Adaptive bot-skill curriculum from match standings.
 
-    (reference: bot_difficulty.py:6-57)
+    (reference: bot_difficulty.py:6-57.)  Note: like the reference,
+    reset always publishes ``bot_difficulty_mean`` to the base env, so
+    whenever this wrapper is in the pipeline (any bots>0 spec) the
+    multiplayer env's named-bot fallback never fires — bots are always
+    difficulty-sampled.  The named path only applies to bare
+    DoomMultiplayerEnv usage.
     """
 
     MIN, MAX, STEP = 0, 150, 10

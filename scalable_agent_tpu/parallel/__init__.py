@@ -2,5 +2,11 @@ from scalable_agent_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
     make_mesh,
+    model_parallel_shardings,
     replicated_sharding,
+)
+from scalable_agent_tpu.parallel.distributed import (
+    initialize_distributed,
+    is_coordinator,
+    local_batch_size,
 )

@@ -1,0 +1,74 @@
+"""Multi-host distribution over DCN.
+
+The reference distributes with the TF1 gRPC runtime: one learner process
+hosting a FIFOQueue, N actor processes enqueueing trajectories and
+reading parameters over gRPC (reference: experiment.py:497-512,531,
+556-562).  The TPU-native replacement is SPMD: every process calls
+``jax.distributed.initialize``; the mesh spans all processes' devices;
+the learner update is ONE jitted program whose data-axis collectives
+ride ICI within a host and DCN across hosts (XLA picks the transport
+from the topology); each host's actor pool contributes its local shard
+of every global batch via ``jax.make_array_from_process_local_data``
+(runtime/learner.py put_trajectory).
+
+Process roles collapse: there is no separate "learner job" — every
+process runs actors AND its slice of the learner, the standard JAX
+multi-host pattern.  Host-side artifacts (metrics, logs) are written by
+process 0 only; checkpoints are written collectively (Orbax handles
+multi-host save/restore of global arrays).
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+from scalable_agent_tpu.utils import log
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed when configured; returns True if the
+    job is multi-process.
+
+    Explicit args win; otherwise standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) or a
+    TPU-pod auto-detecting environment apply.  A no-config single
+    process is left untouched.
+    """
+    coordinator = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator is None and num_processes is None:
+        return jax.process_count() > 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info("jax.distributed up: process %d/%d, %d local / %d global "
+             "devices", jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+    return jax.process_count() > 1
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def local_batch_size(global_batch: int) -> int:
+    """Per-process share of a batch sharded over all processes."""
+    processes = jax.process_count()
+    if global_batch % processes:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{processes} processes")
+    return global_batch // processes

@@ -1,0 +1,76 @@
+"""Runnable multi-process dry run: 2+ CPU processes, one SPMD learner.
+
+Each process (launched with identical commands, differing only in
+--process_id / JAX_PROCESS_ID) contributes its local half of every
+batch; the update runs over a mesh spanning both processes' virtual CPU
+devices, exercising the exact multi-host path of driver.train —
+jax.distributed init, global mesh, make_array_from_process_local_data
+batch assembly, collective update, replicated metric readback.
+
+Usage (what __graft_entry__.dryrun_multiprocess and
+tests/test_distributed.py run):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python -m scalable_agent_tpu.parallel.dryrun_process \
+        --coordinator=localhost:PORT --num_processes=2 --process_id=I
+"""
+
+import argparse
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--coordinator", required=True)
+    parser.add_argument("--num_processes", type=int, required=True)
+    parser.add_argument("--process_id", type=int, required=True)
+    parser.add_argument("--updates", type=int, default=2)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from scalable_agent_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
+
+    initialize_distributed(args.coordinator, args.num_processes,
+                           args.process_id)
+    assert jax.process_count() == args.num_processes
+
+    import numpy as np
+
+    from __graft_entry__ import _example_trajectory
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+
+    unroll_len, height, width, num_actions = 4, 16, 16, 6
+    global_batch = 2 * jax.device_count()
+    local_batch = global_batch // jax.process_count()
+    agent = ImpalaAgent(num_actions=num_actions)
+    mesh = make_mesh(MeshSpec(data=jax.device_count(), model=1))
+    learner = Learner(agent, LearnerHyperparams(), mesh,
+                      frames_per_update=global_batch * unroll_len * 4)
+    # Identical seeds on every process -> identical initial params.
+    state = learner.init(
+        jax.random.key(0),
+        _example_trajectory(unroll_len, 1, height, width, num_actions))
+    for update in range(args.updates):
+        local = _example_trajectory(
+            unroll_len, local_batch, height, width, num_actions)
+        traj = learner.put_trajectory(local)
+        state, metrics = learner.update(state, traj)
+    loss = float(np.asarray(
+        metrics["total_loss"].addressable_shards[0].data))
+    frames = float(np.asarray(
+        metrics["env_frames"].addressable_shards[0].data))
+    assert np.isfinite(loss), loss
+    expected = args.updates * global_batch * unroll_len * 4
+    assert frames == expected, (frames, expected)
+    print(f"DRYRUN-MP-OK process={jax.process_index()} "
+          f"loss={loss:.4f} frames={frames:.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

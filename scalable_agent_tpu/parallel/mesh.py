@@ -61,3 +61,30 @@ def batch_sharding(mesh: Mesh, batch_axis_index: int = 1) -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully replicated (params, optimizer state, scalars)."""
     return NamedSharding(mesh, PartitionSpec())
+
+
+def model_parallel_shardings(mesh: Mesh, tree):
+    """Tensor-parallel shardings for a params-shaped pytree.
+
+    Output-channel partitioning: every rank>=2 leaf whose LAST axis
+    divides the ``model`` axis size shards that axis over ``model``
+    (conv kernels [kh, kw, cin, cout] and dense/LSTM kernels [in, out]
+    split their output features; XLA inserts the all-gathers/psums the
+    dataflow needs).  Biases, scalars, and indivisible leaves (e.g. a
+    9-logit head on model=2) replicate.  With model=1 every leaf
+    replicates, so this is always safe to use.
+
+    Works for optimizer state too: rmsprop/momentum accumulators are
+    params-shaped, so the same rule aligns them with their params.
+    """
+    model_size = mesh.shape["model"]
+
+    def shard(leaf):
+        shape = getattr(leaf, "shape", ())
+        if (model_size > 1 and len(shape) >= 2
+                and shape[-1] % model_size == 0):
+            spec = [None] * (len(shape) - 1) + ["model"]
+            return NamedSharding(mesh, PartitionSpec(*spec))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree_util.tree_map(shard, tree)

@@ -188,7 +188,9 @@ class ActorPool:
         # learner's (mesh-sharded) params as a single-device snapshot — the
         # explicit versioned weight publication replacing the reference's
         # parameter-server variable reads (reference: experiment.py:503-505).
-        self._inference_device = inference_device or jax.devices()[0]
+        # local_devices: in a multi-host job each process's actors infer
+        # on that process's own first device.
+        self._inference_device = inference_device or jax.local_devices()[0]
         self._agent = agent
         if inference_mode == "structural":
             step_fn = jax.jit(
@@ -318,6 +320,25 @@ class ActorPool:
         multi-device mesh the resharding device_put materializes fresh
         buffers by itself, so the extra copy is skipped.
         """
+        def local_view(leaf):
+            # Multi-host: a global array isn't fully addressable here.
+            # Replicated leaves carry the full value in every local
+            # shard — take this process's copy.  (Cross-host
+            # tensor-sharded params would need a DCN gather; actors
+            # don't support that layout.)
+            if (hasattr(leaf, "is_fully_addressable")
+                    and not leaf.is_fully_addressable):
+                shard = leaf.addressable_shards[0].data
+                if shard.shape != leaf.shape:
+                    raise NotImplementedError(
+                        "actor inference needs replicated (or host-"
+                        "local) params; got a cross-host-sharded leaf "
+                        f"of shape {leaf.shape} with local shard "
+                        f"{shard.shape}")
+                return shard
+            return leaf
+
+        params = jax.tree_util.tree_map(local_view, params)
         may_alias = any(
             getattr(leaf, "devices", None) is not None
             and leaf.devices() == {self._inference_device}

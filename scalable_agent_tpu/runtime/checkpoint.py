@@ -23,27 +23,81 @@ import orbax.checkpoint as ocp
 from scalable_agent_tpu.runtime.learner import TrainState
 
 
+def _to_host(x):
+    """Fetch an array to host memory, multi-host safe: non-addressable
+    global arrays are allgathered (a collective — every process must
+    reach this together)."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 class CheckpointManager:
+    """Cadenced save/restore.  Multi-process discipline: ONLY process 0
+    owns an Orbax manager and touches the checkpoint directory; the
+    state is allgathered to host collectively before a save, and a
+    restore is read by process 0 and broadcast to everyone — so the
+    on-disk format is identical to single-host runs and no two
+    processes ever race on the same paths."""
+
     def __init__(self, logdir: str, interval_s: float = 600.0,
                  keep: int = 5):
         self._dir = os.path.join(os.path.abspath(logdir), "checkpoints")
-        os.makedirs(self._dir, exist_ok=True)
-        self._manager = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep, create=True),
-        )
+        self._is_primary = jax.process_index() == 0
+        self._manager = None
+        if self._is_primary:
+            os.makedirs(self._dir, exist_ok=True)
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True)
+            if jax.process_count() > 1:
+                # The manager lives ONLY on process 0; restrict orbax's
+                # internal barriers to it, or its construction/save
+                # collectives would pair up with unrelated collectives
+                # on the other processes.
+                from orbax.checkpoint import options as ocp_options
+
+                # create=False: with active_processes set, orbax insists
+                # the caller makes the root dir (done above).
+                options = ocp.CheckpointManagerOptions(
+                    max_to_keep=keep, create=False,
+                    multiprocessing_options=(
+                        ocp_options.MultiprocessingOptions(
+                            primary_host=0, active_processes={0})),
+                )
+            self._manager = ocp.CheckpointManager(self._dir,
+                                                  options=options)
         self._interval_s = interval_s
         self._last_save = 0.0
 
     def maybe_save(self, step: int, state: TrainState,
                    force: bool = False) -> bool:
-        """Save if the cadence interval elapsed.  ``step`` = update index."""
+        """Save if the cadence interval elapsed.  ``step`` = update index.
+
+        Multi-process: the wall-clock decision is process 0's, broadcast
+        so every process enters the collective allgather (or none does)
+        — divergent local clocks must never deadlock it."""
         now = time.monotonic()
-        if not force and now - self._last_save < self._interval_s:
+        decision = force or now - self._last_save >= self._interval_s
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            decision = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(decision)))
+        if not decision:
             return False
-        host_state = jax.tree_util.tree_map(np.asarray, state)
-        self._manager.save(step, args=ocp.args.StandardSave(host_state))
+        host_state = jax.tree_util.tree_map(_to_host, state)
+        if self._manager is not None:
+            self._manager.save(
+                step, args=ocp.args.StandardSave(host_state))
+            if jax.process_count() > 1:
+                # Complete the write before any peer can race ahead to
+                # process exit — a departing peer tears down the
+                # coordination service and cancels in-flight async
+                # writes on the primary.
+                self._manager.wait_until_finished()
         self._last_save = now
         return True
 
@@ -55,20 +109,45 @@ class CheckpointManager:
         TrainState) — required to restore custom NamedTuple nodes like
         optax optimizer states with their original types.
         """
-        step = self._manager.latest_step()
+        multiprocess = jax.process_count() > 1
+        step = self._manager.latest_step() if self._is_primary else None
+        if multiprocess:
+            from jax.experimental import multihost_utils
+
+            step = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(-1 if step is None else step)))
+            if step < 0:
+                return None
+            if target is None:
+                raise ValueError(
+                    "multi-process restore requires a structure target "
+                    "(the broadcast needs a pytree shape donor)")
+            # Collective (_to_host allgathers) — only pay it once a
+            # checkpoint actually exists; every process agrees on step.
+            host_target = jax.tree_util.tree_map(_to_host, target)
+            if self._is_primary:
+                restored = self._manager.restore(
+                    step, args=(None if host_target is None else
+                                ocp.args.StandardRestore(host_target)))
+            else:
+                restored = host_target  # structure donor for broadcast
+            restored = multihost_utils.broadcast_one_to_all(restored)
+            return step, restored
         if step is None:
             return None
         if target is None:
             restored = self._manager.restore(step)
         else:
-            host_target = jax.tree_util.tree_map(np.asarray, target)
+            host_target = jax.tree_util.tree_map(_to_host, target)
             restored = self._manager.restore(
                 step, args=ocp.args.StandardRestore(host_target))
         return step, restored
 
     def wait(self):
-        self._manager.wait_until_finished()
+        if self._manager is not None:
+            self._manager.wait_until_finished()
 
     def close(self):
-        self._manager.wait_until_finished()
-        self._manager.close()
+        if self._manager is not None:
+            self._manager.wait_until_finished()
+            self._manager.close()

@@ -29,6 +29,7 @@ from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from scalable_agent_tpu.models.agent import ImpalaAgent
@@ -36,6 +37,7 @@ from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
 from scalable_agent_tpu.parallel.mesh import (
     batch_sharding,
+    model_parallel_shardings,
     replicated_sharding,
 )
 from scalable_agent_tpu.types import AgentOutput, AgentState, StepOutput
@@ -77,6 +79,17 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     env_frames: jax.Array  # f32 scalar, counts frames in exact multiples
+
+
+def _broadcast_prefix(prefix: Trajectory, full: Trajectory):
+    """Expand a per-field sharding prefix tree into a flat list aligned
+    with ``full``'s leaves (None leaves included)."""
+    out = []
+    for sharding, subtree in zip(prefix, full):
+        count = len(jax.tree_util.tree_leaves(
+            subtree, is_leaf=lambda x: x is None))
+        out.extend([sharding] * count)
+    return out
 
 
 def _make_optimizer(hp: LearnerHyperparams) -> optax.GradientTransformation:
@@ -136,12 +149,13 @@ class Learner:
             env_outputs=batch_tb,
             agent_outputs=batch_tb,
         )
-        self._update = jax.jit(
-            self._update_impl,
-            in_shardings=(replicated, traj_shardings),
-            out_shardings=(replicated, replicated),
-            donate_argnums=(0,),
-        )
+        # Computation follows data: ``init``/``place_state`` and
+        # ``put_trajectory`` commit arguments to their mesh shardings
+        # (params/optimizer tensor-parallel over 'model', batch over
+        # 'data'), and jit compiles the SPMD program from the argument
+        # placements — no in_shardings pinning, so the same Learner
+        # serves any (data, model) mesh shape.
+        self._update = jax.jit(self._update_impl, donate_argnums=(0,))
         self._replicated = replicated
         self._traj_shardings = traj_shardings
 
@@ -165,10 +179,46 @@ class Learner:
             opt_state=opt_state,
             env_frames=jnp.float32(env_frames),
         )
-        return jax.device_put(state, self._replicated)
+        return self.place_state(state)
+
+    def state_shardings(self, state: TrainState) -> TrainState:
+        """Sharding pytree for a TrainState: params + optimizer state
+        tensor-parallel over 'model' (replicated when model=1), frame
+        counter replicated."""
+        return TrainState(
+            params=model_parallel_shardings(self._mesh, state.params),
+            opt_state=model_parallel_shardings(
+                self._mesh, state.opt_state),
+            env_frames=self._replicated,
+        )
+
+    def place_state(self, state: TrainState) -> TrainState:
+        """Commit a (host or device) TrainState onto the mesh — also the
+        restore path after checkpoint load."""
+        return jax.device_put(state, self.state_shardings(state))
 
     def put_trajectory(self, trajectory: Trajectory) -> Trajectory:
-        """Host batch -> device, sharded over the data axis."""
+        """Host batch -> device, sharded over the data axis.
+
+        Multi-process (multi-host): each process holds its LOCAL batch
+        shard; the global array is assembled from per-process data so
+        the data axis spans hosts (DCN) exactly like the reference's
+        actors feeding one learner queue over gRPC
+        (reference: experiment.py:531,556-562)."""
+        if jax.process_count() > 1:
+            def build(sharding, local):
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(local))
+
+            shardings_flat = _broadcast_prefix(
+                self._traj_shardings, trajectory)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                trajectory, is_leaf=lambda x: x is None)
+            placed = [
+                None if leaf is None else build(sh, leaf)
+                for sh, leaf in zip(shardings_flat, leaves)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, placed)
         return jax.device_put(trajectory, self._traj_shardings)
 
     # -- update -----------------------------------------------------------

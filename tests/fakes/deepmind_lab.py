@@ -1,0 +1,55 @@
+"""Fake ``deepmind_lab`` module for hermetic suite-eval tests.
+
+Real-file twin of the in-process FakeLab used by tests/test_env_adapters
+so spawned env worker subprocesses can import it when this directory is
+on sys.path.  Deterministic short episodes with a per-level reward bias
+so different suite levels produce different mean returns.
+"""
+
+import os
+
+import numpy as np
+
+EPISODE_STEPS = int(os.environ.get("FAKE_DMLAB_EPISODE_STEPS", "6"))
+
+
+def set_runfiles_path(path):
+    pass
+
+
+class Lab:
+    def __init__(self, level, observations, config, renderer,
+                 level_cache=None):
+        self.level = level
+        self.observation_names = list(observations)
+        self.config = config
+        self.renderer = renderer
+        self.level_cache = level_cache
+        self.width = int(config["width"])
+        self.height = int(config["height"])
+        self._steps = 0
+        self._seed = 0
+        # deterministic per-level flavor
+        self._bias = (sum(level.encode()) % 7) * 0.1
+
+    def reset(self, seed=None):
+        self._seed = seed or 0
+        self._steps = 0
+
+    def observations(self):
+        obs = {"RGB_INTERLEAVED": np.full(
+            (self.height, self.width, 3),
+            (self._steps * 11 + self._seed) % 251, np.uint8)}
+        if "INSTR" in self.observation_names:
+            obs["INSTR"] = b""
+        return obs
+
+    def step(self, action, num_steps=1):
+        self._steps += 1
+        return float(num_steps) * (0.25 + self._bias)
+
+    def is_running(self):
+        return self._steps < EPISODE_STEPS
+
+    def close(self):
+        pass

@@ -198,13 +198,18 @@ class TestDoomPipeline:
                                     num_action_repeats=4)
         try:
             stream.initial()
-            out = stream.step(1)
-            assert out.info.episode_step == 1
-            # 16 agent steps per 64-tic fake episode; episode accounting
-            # resets across the auto-reset boundary
-            for _ in range(15):
+            # Exactly 16 agent steps per 64-tic fake episode: the
+            # simulator's native make_action skip must NOT be doubled by
+            # an extra SkipFramesWrapper (4x4=16 tics/step would end the
+            # episode after 4 agent steps).
+            steps_to_done = 0
+            done = False
+            while not done:
                 out = stream.step(1)
-            assert out.done
+                steps_to_done += 1
+                done = bool(out.done)
+                assert steps_to_done <= 16, "episode ended late"
+            assert steps_to_done == 16, steps_to_done
         finally:
             stream.close()
 

@@ -1,0 +1,100 @@
+"""Full-suite eval mode: batched multi-level test() with suite scores.
+
+(VERDICT r2 item 7: the reference loops all level names and computes
+capped/uncapped human-normalized suite scores, experiment.py:675-708,
+716-717; done-criterion = suite score emitted for the dmlab30 list on
+fakes.)
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.envs import dmlab30
+
+FAKES_DIR = os.path.join(os.path.dirname(__file__), "fakes")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def fake_lab():
+    sys.path.insert(0, FAKES_DIR)
+    sys.modules.pop("deepmind_lab", None)
+    yield
+    sys.path.remove(FAKES_DIR)
+    sys.modules.pop("deepmind_lab", None)
+
+
+@pytest.fixture(scope="module")
+def trained_logdir(tmp_path_factory, fake_lab):
+    """A tiny checkpointed train run on one dmlab level (fake sim)."""
+    from scalable_agent_tpu.driver import train
+
+    logdir = str(tmp_path_factory.mktemp("suite_eval") / "run")
+    config = Config(
+        mode="train",
+        logdir=logdir,
+        level_name="dmlab_explore_goal_locations_small",
+        num_actors=2, batch_size=2, unroll_length=3,
+        num_action_repeats=2, num_env_workers_per_group=1,
+        total_environment_frames=2 * 2 * 3 * 2,  # 2 updates
+        compute_dtype="float32",
+        checkpoint_interval_s=1e9,
+    )
+    train(config)
+    return logdir
+
+
+@pytest.mark.slow
+def test_suite_eval_emits_scores(trained_logdir):
+    from scalable_agent_tpu.driver import test as run_test
+
+    config = Config(
+        mode="test",
+        logdir=trained_logdir,
+        level_name="dmlab30",
+        num_action_repeats=2,
+        test_num_episodes=2,
+        test_batch_size=2,
+        test_num_workers=1,
+        width=96, height=72,
+    )
+    level_returns = run_test(config)
+
+    # every suite test level evaluated with the requested episode count
+    assert len(level_returns) == len(dmlab30.TEST_LEVELS)
+    for name, returns in level_returns.items():
+        assert name.startswith("dmlab_")
+        assert len(returns) == 2, name
+
+    scores_path = os.path.join(trained_logdir, "eval_scores.json")
+    assert os.path.exists(scores_path)
+    with open(scores_path) as f:
+        scores = json.load(f)
+    assert np.isfinite(scores["human_normalized_no_cap"])
+    assert np.isfinite(scores["human_normalized_cap_100"])
+    assert scores["human_normalized_cap_100"] <= scores[
+        "human_normalized_no_cap"] + 1e-9
+    assert len(scores["mean_returns"]) == 30
+
+
+def test_single_level_eval_still_works(trained_logdir):
+    from scalable_agent_tpu.driver import test as run_test
+
+    config = Config(
+        mode="test",
+        logdir=trained_logdir,
+        level_name="dmlab_explore_goal_locations_small",
+        num_action_repeats=2,
+        test_num_episodes=3,
+        test_batch_size=2,
+        test_num_workers=1,
+        width=96, height=72,
+    )
+    level_returns = run_test(config)
+    returns = level_returns["dmlab_explore_goal_locations_small"]
+    assert len(returns) == 3
+    assert all(np.isfinite(r) for r in returns)
