@@ -76,6 +76,11 @@ class Config:
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5
     log_interval_s: float = 10.0
+    # jax.profiler tracing (SURVEY §5.1): capture device+host traces for
+    # profile_num_updates updates starting at profile_start_update.
+    profile_dir: str = ""  # empty = disabled
+    profile_start_update: int = 10
+    profile_num_updates: int = 5
 
     # -------------------------------------------------------------------
 

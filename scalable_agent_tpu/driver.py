@@ -224,10 +224,23 @@ def train(config: Config) -> Dict[str, float]:
     import math
 
     n_devices = len(jax.devices())
-    # The batch axis shards over 'data': pick the largest data-axis size
-    # that divides the batch (a 4-batch debug run on an 8-device mesh uses
-    # 4 of them rather than failing).
-    mesh_data = config.mesh_data or math.gcd(config.batch_size, n_devices)
+    if jax.process_count() > 1:
+        # Multi-host meshes must span EVERY process's devices: a
+        # truncated device list would exclude whole processes, whose
+        # local batch shards then have no addressable home in
+        # make_array_from_process_local_data.
+        mesh_data = config.mesh_data or n_devices // config.mesh_model
+        if mesh_data * config.mesh_model != n_devices:
+            raise ValueError(
+                f"multi-host mesh (data={mesh_data}, "
+                f"model={config.mesh_model}) must cover all "
+                f"{n_devices} global devices")
+    else:
+        # The batch axis shards over 'data': pick the largest data-axis
+        # size that divides the batch (a 4-batch debug run on an
+        # 8-device mesh uses 4 of them rather than failing).
+        mesh_data = config.mesh_data or math.gcd(
+            config.batch_size, n_devices)
     if config.batch_size % mesh_data:
         raise ValueError(
             f"batch_size {config.batch_size} not divisible by data-axis "
@@ -276,8 +289,6 @@ def train(config: Config) -> Dict[str, float]:
     prefetch_stop = threading.Event()
     prefetch_thread = start_prefetch(pool, learner, staged, prefetch_stop)
 
-    from scalable_agent_tpu.parallel.distributed import is_coordinator
-
     writer = MetricsWriter(config.logdir) if is_coordinator() else None
     timing = Timing()
     updates = start_updates
@@ -290,8 +301,20 @@ def train(config: Config) -> Dict[str, float]:
     last_log = time.monotonic()
     frames_at_last_log = frames
     metrics = {}
+    completed = False
+    # Device-level tracing (SURVEY §5.1): --profile_dir captures a
+    # jax.profiler trace of updates [profile_start_update,
+    # +profile_num_updates) viewable in TensorBoard/XProf — the tool for
+    # locating host↔device stalls the Timing counters can't attribute.
+    profiling = False
     try:
         while frames < config.total_environment_frames:
+            if (config.profile_dir and not profiling
+                    and updates - start_updates
+                    == config.profile_start_update):
+                jax.profiler.start_trace(config.profile_dir)
+                profiling = True
+                profile_stop_at = updates + config.profile_num_updates
             with timing.time_avg("wait_batch"):
                 traj = staged.get()
             if isinstance(traj, Exception):
@@ -301,6 +324,12 @@ def train(config: Config) -> Dict[str, float]:
             pool.set_params(state.params, version=updates)
             updates += 1
             frames += frames_per_update
+            if profiling and updates >= profile_stop_at:
+                jax.block_until_ready(metrics["total_loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+                log.info("profiler trace written to %s",
+                         config.profile_dir)
 
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:
@@ -326,16 +355,23 @@ def train(config: Config) -> Dict[str, float]:
                 last_log, frames_at_last_log = now, frames
             ckpt.maybe_save(updates, state)
         ckpt.maybe_save(updates, state, force=True)
+        completed = True
     finally:
+        if profiling:
+            jax.profiler.stop_trace()
         prefetch_stop.set()
         pool.stop()
         prefetch_thread.join(timeout=5)
         if writer is not None:
             writer.close()
         ckpt.close()
-        if jax.process_count() > 1:
+        if completed and jax.process_count() > 1:
             # No process may exit (tearing down the coordination
             # service) until every process finished its checkpoint IO.
+            # Skipped on the EXCEPTION path: a failed process must not
+            # block in a barrier its healthy peers (stuck inside their
+            # own collectives) can never reach — dying fast surfaces
+            # the error and unblocks everyone.
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("train_exit")
@@ -360,6 +396,12 @@ def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
     ]
     envs = MultiEnv(fns, frame_spec,
                     num_workers=min(batch, config.test_num_workers))
+    # Fixed per-env episode quota: taking the global first-N completions
+    # would overrepresent short episodes (fast finishers complete more
+    # often), biasing mean returns vs the reference's one-env sequential
+    # protocol.  Each env contributes at most ceil(N / batch) episodes.
+    quota = -(-num_episodes // batch)
+    counts = np.zeros((batch,), np.int64)
     returns: List[float] = []
     try:
         output = envs.initial()
@@ -376,7 +418,9 @@ def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
             envs.step_send(action)
             output = envs.step_recv()
             for i in np.nonzero(np.asarray(output.done))[0]:
-                if int(output.info.episode_step[i]) > 0:
+                if (int(output.info.episode_step[i]) > 0
+                        and counts[i] < quota):
+                    counts[i] += 1
                     returns.append(float(output.info.episode_return[i]))
     finally:
         envs.close()
@@ -453,18 +497,22 @@ def test(config: Config) -> Dict[str, List[float]]:
                                  for k, v in by_level.items()},
             }, f, indent=2)
         log.info("suite scores written to %s", scores_path)
-    elif config.level_name in dmlab30.ALL_LEVELS:
+    else:
         # Single-level runs can't produce the full-suite score; log the
         # per-level normalized value (reference computes the suite mean,
-        # experiment.py:703-708).
-        returns = level_returns[config.level_name]
-        record = dmlab30.LEVELS.get(
-            config.level_name,
-            dmlab30._BY_TEST_NAME.get(config.level_name))
-        if record:
-            normalized = (np.mean(returns) - record.random) / (
-                record.human - record.random) * 100.0
-            log.info("human-normalized: %.2f%%", normalized)
+        # experiment.py:703-708).  Registry names carry the dmlab_
+        # prefix; the score tables hold bare level names.
+        bare = (config.level_name[len("dmlab_"):]
+                if config.level_name.startswith("dmlab_")
+                else config.level_name)
+        if bare in dmlab30.ALL_LEVELS:
+            returns = level_returns[config.level_name]
+            record = dmlab30.LEVELS.get(
+                bare, dmlab30._BY_TEST_NAME.get(bare))
+            if record:
+                normalized = (np.mean(returns) - record.random) / (
+                    record.human - record.random) * 100.0
+                log.info("human-normalized: %.2f%%", normalized)
     return level_returns
 
 

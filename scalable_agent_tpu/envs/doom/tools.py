@@ -1,0 +1,132 @@
+"""Doom tooling: throughput sampling, observation grids, demo replay.
+
+The reference ships small standalone drivers (reference:
+envs/doom/sample_env.py:8-18 random-policy FPS sampler,
+doom_render.py:5-34 observation grid, doom_play_demo.py:14-51 demo
+replayer, play_doom.py:8-18 human play).  Equivalents here are plain
+functions; run e.g.:
+
+    python -m scalable_agent_tpu.envs.doom.tools sample doom_benchmark
+"""
+
+import math
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from scalable_agent_tpu.envs.doom.factory import make_doom_env
+from scalable_agent_tpu.utils import log
+
+
+def sample_env(env_name: str = "doom_benchmark", num_steps: int = 1000,
+               num_action_repeats: int = 4, seed: int = 0) -> float:
+    """Random-policy throughput probe; returns env frames/sec.
+
+    (reference: sample_env.py:8-18)
+    """
+    env = make_doom_env(env_name, num_action_repeats=num_action_repeats)
+    rng = np.random.default_rng(seed)
+    try:
+        env.reset()
+        t0 = time.perf_counter()
+        for _ in range(num_steps):
+            _, _, done, _ = env.step(env.action_space.sample(rng))
+            if done:
+                env.reset()
+        dt = time.perf_counter() - t0
+        fps = num_steps * num_action_repeats / dt
+        log.info("%s: %.1f env frames/s (%.1f agent steps/s)",
+                 env_name, fps, num_steps / dt)
+        return fps
+    finally:
+        env.close()
+
+
+def concat_grid(frames: List[np.ndarray]) -> np.ndarray:
+    """Tile per-agent frames into one image for rendering.
+
+    (reference: doom_render.py:5-34)
+    """
+    if not frames:
+        raise ValueError("no frames")
+    n = len(frames)
+    cols = int(math.ceil(math.sqrt(n)))
+    rows = int(math.ceil(n / cols))
+    h, w, c = frames[0].shape
+    grid = np.zeros((rows * h, cols * w, c), frames[0].dtype)
+    for i, frame in enumerate(frames):
+        r, col = divmod(i, cols)
+        grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = frame
+    return grid
+
+
+def replay_demo(env_name: str, demo_path: str,
+                out_dir: Optional[str] = None,
+                num_action_repeats: int = 4) -> int:
+    """Replay a recorded .lmp demo, dumping frames as .npy files.
+
+    (reference: doom_play_demo.py:14-51 — PNG via cv2 there; .npy here
+    to avoid the image-codec dependency.)  Returns the frame count.
+    """
+    import vizdoom
+
+    env = make_doom_env(env_name, num_action_repeats=num_action_repeats)
+    base = env.unwrapped
+    base._ensure_game()
+    game = base.game
+    game.close()
+    game.set_mode(vizdoom.Mode.PLAYER)
+    game.init()
+    game.replay_episode(demo_path)
+    frames = 0
+    out_dir = out_dir or os.path.splitext(demo_path)[0] + "_frames"
+    os.makedirs(out_dir, exist_ok=True)
+    try:
+        while not game.is_episode_finished():
+            state = game.get_state()
+            if state is not None and state.screen_buffer is not None:
+                frame = np.transpose(state.screen_buffer, (1, 2, 0))
+                np.save(os.path.join(out_dir, f"{frames:05d}.npy"), frame)
+                frames += 1
+            game.advance_action()
+        return frames
+    finally:
+        env.close()
+
+
+def play_human(env_name: str = "doom_basic") -> None:
+    """Interactive human play (needs pynput + a display).
+
+    (reference: play_doom.py:8-18, doom_gym.py:465-542)
+    """
+    try:
+        import pynput  # noqa: F401
+    except ImportError as exc:
+        raise RuntimeError(
+            "human play needs the optional 'pynput' package") from exc
+    raise NotImplementedError(
+        "interactive play requires a display; use replay_demo/sample_env "
+        "in headless environments")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return
+    command, args = argv[0], argv[1:]
+    if command == "sample":
+        sample_env(*(args or ["doom_benchmark"]))
+    elif command == "replay":
+        replay_demo(*args)
+    elif command == "play":
+        play_human(*(args or ["doom_basic"]))
+    else:
+        raise SystemExit(f"unknown command {command!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,14 +38,38 @@ from scalable_agent_tpu.types import (
 )
 
 
+def _reseeded(make_stream_fns, generation: int):
+    """Respawned workers must not replay identical episode streams: for
+    the standard ``functools.partial(make_impala_stream, seed=...)``
+    factories, shift the seed per generation; opaque factories pass
+    through unchanged (reference analog: the multiplayer init-retry
+    re-creates envs with fresh state,
+    doom_multiagent_wrapper.py:225-273)."""
+    if generation <= 0:
+        return list(make_stream_fns)
+    import functools
+
+    out = []
+    for make in make_stream_fns:
+        if (isinstance(make, functools.partial)
+                and "seed" in (make.keywords or {})):
+            kwargs = dict(make.keywords)
+            kwargs["seed"] = kwargs["seed"] + 90001 * generation
+            make = functools.partial(make.func, *make.args, **kwargs)
+        out.append(make)
+    return out
+
+
 def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
-                     slab_shape, slab_dtype, first_index: int):
+                     slab_shape, slab_dtype, first_index: int,
+                     generation: int = 0):
     """Hosts a contiguous slice of the env batch.  One process, k envs."""
     streams = []
     shm = None
     try:
         try:
-            make_streams = pickle.loads(make_streams_pickled)
+            make_streams = _reseeded(
+                pickle.loads(make_streams_pickled), generation)
             streams = [make() for make in make_streams]
             shm = shared_memory.SharedMemory(name=shm_name)
             slab = np.ndarray(slab_shape, slab_dtype, buffer=shm.buf)
@@ -128,42 +152,46 @@ class MultiEnv:
         num_workers: Optional[int] = None,
         stats_episodes: int = 100,
         ctx: Optional[str] = None,
+        max_respawns: int = 16,
     ):
         self.num_envs = len(make_stream_fns)
         num_workers = min(num_workers or self.num_envs, self.num_envs)
         # spawn, not fork: see EnvProcess — the parent runs JAX.
         self._ctx = mp.get_context(ctx or "spawn")
         self._frame_spec = frame_spec
-        slab_shape = (self.num_envs,) + tuple(frame_spec.shape)
-        nbytes = int(np.prod(slab_shape)
+        self._slab_shape = (self.num_envs,) + tuple(frame_spec.shape)
+        nbytes = int(np.prod(self._slab_shape)
                      * np.dtype(frame_spec.dtype).itemsize)
         self._shm = shared_memory.SharedMemory(create=True, size=nbytes)
-        self._slab = np.ndarray(slab_shape, frame_spec.dtype,
+        self._slab = np.ndarray(self._slab_shape, frame_spec.dtype,
                                 buffer=self._shm.buf)
+
+        # Fault tolerance: a worker process dying takes down only its
+        # slice — it is respawned with generation-shifted seeds and its
+        # envs restart from fresh episodes (SURVEY §5.3; the reference
+        # kills+recreates stuck workers, doom_multiagent_wrapper.py:
+        # 225-273).  ``max_respawns`` bounds crash loops.
+        self.max_respawns = max_respawns
+        self.total_respawns = 0
 
         # Shard envs over workers as evenly as possible.
         base, extra = divmod(self.num_envs, num_workers)
         sizes = [base + (1 if w < extra else 0) for w in range(num_workers)]
         self._slices = []
+        self._fns_pickled = []
+        self._generations = []
         self._procs = []
         self._conns = []
         start = 0
         for w, size in enumerate(sizes):
             sl = slice(start, start + size)
             self._slices.append(sl)
-            parent_conn, child_conn = self._ctx.Pipe()
-            proc = self._ctx.Process(
-                target=_vec_worker_main,
-                args=(child_conn,
-                      pickle.dumps(list(make_stream_fns[sl])),
-                      self._shm.name, slab_shape,
-                      np.dtype(frame_spec.dtype), start),
-                daemon=True,
-            )
-            proc.start()
-            child_conn.close()
-            self._procs.append(proc)
-            self._conns.append(parent_conn)
+            self._fns_pickled.append(
+                pickle.dumps(list(make_stream_fns[sl])))
+            self._generations.append(0)
+            self._procs.append(None)
+            self._conns.append(None)
+            self._spawn_worker(w)
             start += size
         failures = []
         for conn in self._conns:
@@ -184,6 +212,52 @@ class MultiEnv:
         self.episode_stats = deque(maxlen=stats_episodes)
         self._pending = False
 
+    def _spawn_worker(self, w: int) -> None:
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=_vec_worker_main,
+            args=(child_conn, self._fns_pickled[w], self._shm.name,
+                  self._slab_shape, np.dtype(self._frame_spec.dtype),
+                  self._slices[w].start, self._generations[w]),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        self._procs[w] = proc
+        self._conns[w] = parent_conn
+
+    def _respawn_worker(self, w: int) -> None:
+        """Replace a dead worker: fresh process, shifted seeds, blocking
+        handshake.  Raises RemoteEnvError past ``max_respawns``."""
+        from scalable_agent_tpu.utils import log
+
+        self.total_respawns += 1
+        if self.total_respawns > self.max_respawns:
+            raise RemoteEnvError(
+                f"env worker {w} died and the respawn budget "
+                f"({self.max_respawns}) is exhausted")
+        log.warning(
+            "env worker %d (envs %d:%d) died — respawning (%d/%d)",
+            w, self._slices[w].start, self._slices[w].stop,
+            self.total_respawns, self.max_respawns)
+        try:
+            self._conns[w].close()
+        except OSError:
+            pass
+        proc = self._procs[w]
+        if proc is not None and proc.is_alive():
+            proc.kill()
+            proc.join(timeout=5)
+        self._generations[w] += 1
+        self._spawn_worker(w)
+        try:
+            ok, payload = self._conns[w].recv()
+        except EOFError:
+            raise RemoteEnvError(
+                f"env worker {w} died again during respawn handshake")
+        if not ok:
+            raise pickle.loads(payload)
+
     # -- protocol ----------------------------------------------------------
 
     def _gather(self) -> StepOutput:
@@ -194,8 +268,17 @@ class MultiEnv:
         instructions = None
         measurements = None
         errors = []
-        for conn, sl in zip(self._conns, self._slices):
-            ok, payload = conn.recv()
+        for w, sl in enumerate(self._slices):
+            try:
+                ok, payload = self._conns[w].recv()
+            except (EOFError, OSError):
+                # Worker died mid-step: respawn and substitute its
+                # slice's fresh initial outputs (done=True marks the
+                # episode boundary; the aborted episode records no
+                # stats — episode_step stays 0).
+                self._respawn_worker(w)
+                self._conns[w].send((_INITIAL,))
+                ok, payload = self._conns[w].recv()
             if not ok:
                 errors.append(pickle.loads(payload))
                 continue
@@ -227,8 +310,12 @@ class MultiEnv:
         )
 
     def initial(self) -> StepOutput:
-        for conn in self._conns:
-            conn.send((_INITIAL,))
+        for w in range(len(self._conns)):
+            try:
+                self._conns[w].send((_INITIAL,))
+            except (BrokenPipeError, OSError):
+                self._respawn_worker(w)
+                self._conns[w].send((_INITIAL,))
         return self._gather()
 
     def step_send(self, actions) -> None:
@@ -236,8 +323,14 @@ class MultiEnv:
         if actions.shape[0] != self.num_envs:
             raise ValueError(
                 f"got {actions.shape[0]} actions for {self.num_envs} envs")
-        for conn, sl in zip(self._conns, self._slices):
-            conn.send((_STEP, actions[sl]))
+        for w, sl in enumerate(self._slices):
+            try:
+                self._conns[w].send((_STEP, actions[sl]))
+            except (BrokenPipeError, OSError):
+                # Dead worker: respawn and request its initial outputs
+                # instead of the lost step (same payload layout).
+                self._respawn_worker(w)
+                self._conns[w].send((_INITIAL,))
         self._pending = True
 
     def step_recv(self) -> StepOutput:

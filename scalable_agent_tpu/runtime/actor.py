@@ -339,13 +339,14 @@ class ActorPool:
             return leaf
 
         params = jax.tree_util.tree_map(local_view, params)
-        may_alias = any(
-            getattr(leaf, "devices", None) is not None
-            and leaf.devices() == {self._inference_device}
-            for leaf in jax.tree_util.tree_leaves(params))
         params = jax.device_put(params, self._inference_device)
-        if may_alias:
-            params = jax.tree_util.tree_map(jnp.copy, params)
+        # ALWAYS materialize a private copy: device_put aliases any
+        # existing copy the target device already holds (single-device
+        # meshes trivially; multi-device replicated params via their
+        # local shard), and the learner's donated update would free the
+        # aliased buffer out from under the actors ("Array has been
+        # deleted").  Params are small; the on-device copy is cheap.
+        params = jax.tree_util.tree_map(jnp.copy, params)
         with self._params_lock:
             self._params = params
             self._params_version = (

@@ -1,0 +1,104 @@
+"""Real-simulator smoke guards (``pytest -m realsim``).
+
+The adapter suites run against hermetic fakes (the right CI call — the
+simulators aren't installed there), but fakes can't catch drift against
+the real APIs (Lab's level_cache calling convention, ALE v5 kwargs,
+VizDoom buffer layouts).  These tests run ONE real episode per family
+and auto-skip wherever the package is missing, so any machine with a
+simulator installed gets the seam checked for free (VERDICT r2 item 9).
+"""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+
+def _has(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+realsim = pytest.mark.realsim
+
+
+@realsim
+@pytest.mark.skipif(not _has("ale_py"), reason="ale_py not installed")
+def test_real_atari_episode():
+    from scalable_agent_tpu.envs import make_impala_stream
+
+    stream = make_impala_stream("atari_breakout", seed=1,
+                                num_action_repeats=4)
+    try:
+        out = stream.initial()
+        frame = np.asarray(out.observation.frame)
+        assert frame.dtype == np.uint8 and frame.ndim == 3
+        steps = 0
+        done = False
+        while not done and steps < 3000:
+            out = stream.step(steps % 4)
+            done = bool(out.done)
+            steps += 1
+        assert steps > 1
+    finally:
+        stream.close()
+
+
+@realsim
+@pytest.mark.skipif(not _has("deepmind_lab"),
+                    reason="deepmind_lab not installed")
+def test_real_dmlab_episode():
+    from scalable_agent_tpu.envs import make_impala_stream
+
+    stream = make_impala_stream(
+        "dmlab_explore_goal_locations_small", seed=1,
+        num_action_repeats=4, width=96, height=72)
+    try:
+        out = stream.initial()
+        assert np.asarray(out.observation.frame).shape == (72, 96, 3)
+        for step in range(20):
+            out = stream.step(step % 9)
+    finally:
+        stream.close()
+
+
+@realsim
+@pytest.mark.skipif(not _has("vizdoom"), reason="vizdoom not installed")
+def test_real_vizdoom_episode():
+    from scalable_agent_tpu.envs import make_impala_stream
+
+    stream = make_impala_stream("doom_basic", seed=1,
+                                num_action_repeats=4)
+    try:
+        out = stream.initial()
+        frame = np.asarray(out.observation.frame)
+        assert frame.dtype == np.uint8 and frame.shape[-1] == 3
+        steps = 0
+        done = False
+        while not done and steps < 500:
+            out = stream.step(steps % 4)
+            done = bool(out.done)
+            steps += 1
+        assert steps > 1
+    finally:
+        stream.close()
+
+
+@realsim
+@pytest.mark.skipif(not _has("vizdoom"), reason="vizdoom not installed")
+def test_real_vizdoom_composite_battle():
+    """The composite-action seam: tuple actions -> flattened buttons."""
+    from scalable_agent_tpu.envs import create_env
+
+    env = create_env("doom_battle", num_action_repeats=4)
+    try:
+        obs = env.reset()
+        assert obs.measurements is not None
+        for step in range(10):
+            obs, reward, done, info = env.step((1, 0, 1, 0, step % 11))
+            if done:
+                break
+    finally:
+        env.close()
