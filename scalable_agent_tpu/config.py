@@ -72,7 +72,7 @@ class Config:
     # "service" (C++ dynamic batcher co-batches groups into one call —
     # the reference's architecture, dynamic_batching.py + batcher.cc).
     inference_mode: str = "structural"
-    scan_impl: str = "associative"  # vtrace scan: associative | sequential
+    scan_impl: str = "associative"  # vtrace: associative | sequential | pallas
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5
     log_interval_s: float = 10.0

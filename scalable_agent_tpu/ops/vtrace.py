@@ -15,7 +15,10 @@ vtrace.py:71-161 ``from_logits``, vtrace.py:164-280
   parallel ``jax.lax.associative_scan`` over composed affine maps — O(log T)
   depth on-device, fully fusable by XLA, and shardable over a mesh axis for
   sequence parallelism.  A sequential ``lax.scan`` path is kept for
-  cross-checking (``scan_impl='sequential'``).
+  cross-checking (``scan_impl='sequential'``), and ``scan_impl='pallas'``
+  runs the whole computation as ONE fused VMEM-resident Pallas kernel
+  (ops/vtrace_pallas.py) — possible precisely because the outputs are
+  stop-gradient'ed, so no VJP is ever needed through it.
 
 - Like the reference, extra trailing dimensions are supported: ``rewards``
   may be [T, B, C...], ``bootstrap_value`` [B, C...] (reference:
@@ -118,6 +121,28 @@ def from_importance_weights(
             f"log_rhos rank {log_rhos.ndim} - 1")
     if discounts.ndim != log_rhos.ndim or rewards.ndim != log_rhos.ndim:
         raise ValueError("discounts/rewards rank must match log_rhos rank")
+
+    if scan_impl == "pallas":
+        # Fused single-kernel path (ops/vtrace_pallas.py).  The kernel is
+        # rank-2 [T, B]; extra trailing value dims are flattened into the
+        # batch (lane) axis — the recurrence is independent per column.
+        from scalable_agent_tpu.ops import vtrace_pallas
+
+        shape = log_rhos.shape
+        # Stop gradients at the kernel INPUTS: the outputs are
+        # stop-gradient'ed anyway, and pallas_call has no JVP rule, so the
+        # tape must be severed before the call, not after.
+        flat = lambda x: lax.stop_gradient(x).reshape(shape[0], -1)
+        bootstrap_value = lax.stop_gradient(bootstrap_value)
+        vs, pg = vtrace_pallas.vtrace_fused(
+            flat(log_rhos), flat(discounts), flat(rewards), flat(values),
+            bootstrap_value.reshape(-1),
+            clip_rho_threshold=clip_rho_threshold,
+            clip_pg_rho_threshold=clip_pg_rho_threshold,
+            interpret=jax.default_backend() != "tpu")
+        return VTraceReturns(
+            vs=lax.stop_gradient(vs.reshape(shape)),
+            pg_advantages=lax.stop_gradient(pg.reshape(shape)))
 
     rhos = jnp.exp(log_rhos)
     if clip_rho_threshold is not None:

@@ -174,7 +174,7 @@ class TestLearnerUpdate:
         out1, _ = collect_trajectory(agent, params)
         hp = LearnerHyperparams()
         metrics_by_impl = {}
-        for impl in ("associative", "sequential"):
+        for impl in ("associative", "sequential", "pallas"):
             learner = Learner(agent, hp, mesh, frames_per_update=T * B,
                               scan_impl=impl)
             state = learner.init(jax.random.key(3), to_trajectory(out1))
@@ -187,6 +187,14 @@ class TestLearnerUpdate:
             rtol=1e-4)
         np.testing.assert_allclose(
             float(metrics_by_impl["associative"]["grad_norm"]),
+            float(metrics_by_impl["sequential"]["grad_norm"]),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            float(metrics_by_impl["pallas"]["total_loss"]),
+            float(metrics_by_impl["sequential"]["total_loss"]),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            float(metrics_by_impl["pallas"]["grad_norm"]),
             float(metrics_by_impl["sequential"]["grad_norm"]),
             rtol=1e-4)
 

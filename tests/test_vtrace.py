@@ -77,7 +77,7 @@ def _make_inputs(seq_len, batch_size, rho_scale=None):
 
 
 @pytest.mark.parametrize("batch_size", [1, 5])
-@pytest.mark.parametrize("scan_impl", ["associative", "sequential"])
+@pytest.mark.parametrize("scan_impl", ["associative", "sequential", "pallas"])
 def test_vtrace_matches_ground_truth(batch_size, scan_impl):
     seq_len = 5
     inputs = _make_inputs(seq_len, batch_size)
@@ -116,6 +116,60 @@ def test_associative_matches_sequential_long_sequence():
         np.asarray(a.vs), np.asarray(s.vs), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(
         np.asarray(a.pg_advantages), np.asarray(s.pg_advantages),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_t1_edge():
+    """T=1 must not emit a zero-size values[1:] slice (Mosaic rejects
+    zero-size vectors)."""
+    inputs = _make_inputs(1, 8)
+    p = vtrace.from_importance_weights(scan_impl="pallas", **inputs)
+    s = vtrace.from_importance_weights(scan_impl="sequential", **inputs)
+    np.testing.assert_allclose(
+        np.asarray(p.vs), np.asarray(s.vs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p.pg_advantages), np.asarray(s.pg_advantages),
+        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("batch_size", [4, 128, 200])
+def test_pallas_matches_sequential_long_sequence(batch_size):
+    """The fused Pallas kernel must agree at T=100 across batch sizes that
+    exercise lane padding (4, 200) and the exact-tile case (128)."""
+    inputs = _make_inputs(100, batch_size)
+    p = vtrace.from_importance_weights(scan_impl="pallas", **inputs)
+    s = vtrace.from_importance_weights(scan_impl="sequential", **inputs)
+    np.testing.assert_allclose(
+        np.asarray(p.vs), np.asarray(s.vs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p.pg_advantages), np.asarray(s.pg_advantages),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_higher_rank_and_no_clipping():
+    """Trailing dims flatten into the lane axis; None thresholds disable
+    clipping inside the kernel."""
+    seq_len, batch_size, c = 4, 2, 3
+    rng = np.random.RandomState(3)
+    inputs = {
+        "log_rhos": rng.uniform(-1, 1, (seq_len, batch_size, c))
+                        .astype(np.float32),
+        "discounts": np.full((seq_len, batch_size, c), 0.9, np.float32),
+        "rewards": _shaped_arange(seq_len, batch_size, c),
+        "values": _shaped_arange(seq_len, batch_size, c) / 10.0,
+        "bootstrap_value": _shaped_arange(batch_size, c),
+    }
+    p = vtrace.from_importance_weights(
+        scan_impl="pallas", clip_rho_threshold=None,
+        clip_pg_rho_threshold=None, **inputs)
+    s = vtrace.from_importance_weights(
+        scan_impl="sequential", clip_rho_threshold=None,
+        clip_pg_rho_threshold=None, **inputs)
+    assert p.vs.shape == (seq_len, batch_size, c)
+    np.testing.assert_allclose(
+        np.asarray(p.vs), np.asarray(s.vs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p.pg_advantages), np.asarray(s.pg_advantages),
         rtol=1e-4, atol=1e-5)
 
 
