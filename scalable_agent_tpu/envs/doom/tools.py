@@ -63,6 +63,23 @@ def concat_grid(frames: List[np.ndarray]) -> np.ndarray:
     return grid
 
 
+def _reinit_game(env_name: str, mode, visible: bool = False,
+                 num_action_repeats: int = 4):
+    """(env, game) with the underlying DoomGame re-initialized in
+    ``mode``: build the env pipeline, then close/reconfigure/re-init the
+    raw game — the shared preamble for replay and human play."""
+    env = make_doom_env(env_name, num_action_repeats=num_action_repeats)
+    base = env.unwrapped
+    base._ensure_game()
+    game = base.game
+    game.close()
+    if visible:
+        game.set_window_visible(True)
+    game.set_mode(mode)
+    game.init()
+    return env, game
+
+
 def replay_demo(env_name: str, demo_path: str,
                 out_dir: Optional[str] = None,
                 num_action_repeats: int = 4) -> int:
@@ -73,13 +90,8 @@ def replay_demo(env_name: str, demo_path: str,
     """
     import vizdoom
 
-    env = make_doom_env(env_name, num_action_repeats=num_action_repeats)
-    base = env.unwrapped
-    base._ensure_game()
-    game = base.game
-    game.close()
-    game.set_mode(vizdoom.Mode.PLAYER)
-    game.init()
+    env, game = _reinit_game(env_name, vizdoom.Mode.PLAYER,
+                             num_action_repeats=num_action_repeats)
     game.replay_episode(demo_path)
     frames = 0
     out_dir = out_dir or os.path.splitext(demo_path)[0] + "_frames"
@@ -97,19 +109,26 @@ def replay_demo(env_name: str, demo_path: str,
         env.close()
 
 
-def play_human(env_name: str = "doom_basic") -> None:
-    """Interactive human play (needs pynput + a display).
+def play_human(env_name: str = "doom_basic", episodes: int = 1) -> None:
+    """Interactive human play via VizDoom SPECTATOR mode (needs a
+    display; the human drives the VizDoom window directly).
 
-    (reference: play_doom.py:8-18, doom_gym.py:465-542)
+    (reference: play_doom.py:8-18, doom_gym.py:465-542 — pynput
+    keyboard capture there; SPECTATOR mode is VizDoom's native
+    equivalent and needs no extra dependency.)
     """
+    import vizdoom
+
+    env, game = _reinit_game(env_name, vizdoom.Mode.SPECTATOR, visible=True)
     try:
-        import pynput  # noqa: F401
-    except ImportError as exc:
-        raise RuntimeError(
-            "human play needs the optional 'pynput' package") from exc
-    raise NotImplementedError(
-        "interactive play requires a display; use replay_demo/sample_env "
-        "in headless environments")
+        for episode in range(episodes):
+            game.new_episode()
+            while not game.is_episode_finished():
+                game.advance_action()
+            log.info("episode %d reward: %.1f",
+                     episode, game.get_total_reward())
+    finally:
+        env.close()
 
 
 def main(argv=None):
@@ -119,11 +138,20 @@ def main(argv=None):
         return
     command, args = argv[0], argv[1:]
     if command == "sample":
-        sample_env(*(args or ["doom_benchmark"]))
+        # sample <env_name> [num_steps] [num_action_repeats] [seed]
+        sample_env(args[0] if args else "doom_benchmark",
+                   *map(int, args[1:4]))
     elif command == "replay":
-        replay_demo(*args)
+        # replay <env_name> <demo_path> [out_dir] [num_action_repeats]
+        if len(args) < 2:
+            raise SystemExit(
+                "usage: replay <env_name> <demo_path> [out_dir] "
+                "[num_action_repeats]")
+        replay_demo(args[0], args[1], args[2] if len(args) > 2 else None,
+                    *map(int, args[3:4]))
     elif command == "play":
-        play_human(*(args or ["doom_basic"]))
+        # play [env_name] [episodes]
+        play_human(args[0] if args else "doom_basic", *map(int, args[1:2]))
     else:
         raise SystemExit(f"unknown command {command!r}")
 

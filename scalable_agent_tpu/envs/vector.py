@@ -153,6 +153,7 @@ class MultiEnv:
         stats_episodes: int = 100,
         ctx: Optional[str] = None,
         max_respawns: int = 16,
+        respawn_window_s: float = 600.0,
     ):
         self.num_envs = len(make_stream_fns)
         num_workers = min(num_workers or self.num_envs, self.num_envs)
@@ -170,9 +171,13 @@ class MultiEnv:
         # slice — it is respawned with generation-shifted seeds and its
         # envs restart from fresh episodes (SURVEY §5.3; the reference
         # kills+recreates stuck workers, doom_multiagent_wrapper.py:
-        # 225-273).  ``max_respawns`` bounds crash loops.
+        # 225-273).  The budget detects crash LOOPS, not lifetime faults:
+        # more than ``max_respawns`` deaths of the SAME worker within
+        # ``respawn_window_s`` aborts; rare independent deaths spread
+        # over a long run recover indefinitely.
         self.max_respawns = max_respawns
-        self.total_respawns = 0
+        self.respawn_window_s = respawn_window_s
+        self.total_respawns = 0  # lifetime stat, never limits recovery
 
         # Shard envs over workers as evenly as possible.
         base, extra = divmod(self.num_envs, num_workers)
@@ -180,6 +185,7 @@ class MultiEnv:
         self._slices = []
         self._fns_pickled = []
         self._generations = []
+        self._respawn_times = []
         self._procs = []
         self._conns = []
         start = 0
@@ -189,6 +195,7 @@ class MultiEnv:
             self._fns_pickled.append(
                 pickle.dumps(list(make_stream_fns[sl])))
             self._generations.append(0)
+            self._respawn_times.append(deque())
             self._procs.append(None)
             self._conns.append(None)
             self._spawn_worker(w)
@@ -228,18 +235,27 @@ class MultiEnv:
 
     def _respawn_worker(self, w: int) -> None:
         """Replace a dead worker: fresh process, shifted seeds, blocking
-        handshake.  Raises RemoteEnvError past ``max_respawns``."""
+        handshake.  Raises RemoteEnvError when worker ``w`` has died more
+        than ``max_respawns`` times within ``respawn_window_s``."""
+        import time as _time
+
         from scalable_agent_tpu.utils import log
 
+        now = _time.monotonic()
+        times = self._respawn_times[w]
+        while times and now - times[0] > self.respawn_window_s:
+            times.popleft()
+        times.append(now)
         self.total_respawns += 1
-        if self.total_respawns > self.max_respawns:
+        if len(times) > self.max_respawns:
             raise RemoteEnvError(
-                f"env worker {w} died and the respawn budget "
-                f"({self.max_respawns}) is exhausted")
+                f"env worker {w} crash-looping: {len(times)} deaths in "
+                f"{self.respawn_window_s:.0f}s (budget {self.max_respawns})")
         log.warning(
-            "env worker %d (envs %d:%d) died — respawning (%d/%d)",
+            "env worker %d (envs %d:%d) died — respawning "
+            "(%d in window, %d lifetime)",
             w, self._slices[w].start, self._slices[w].stop,
-            self.total_respawns, self.max_respawns)
+            len(times), self.total_respawns)
         try:
             self._conns[w].close()
         except OSError:

@@ -349,3 +349,20 @@ class TestDriverDoom:
         metrics = train(config)
         assert np.isfinite(metrics["total_loss"])
         assert metrics["env_frames"] == config.total_environment_frames
+
+
+class TestTools:
+    def test_sample_cli_converts_numeric_args(self):
+        """main() must int()-convert numeric CLI args before they reach
+        range()/make_action (regression: '500' crashed sample_env)."""
+        from scalable_agent_tpu.envs.doom import tools
+
+        tools.main(["sample", "doom_basic", "8", "2", "3"])
+
+    def test_concat_grid(self):
+        from scalable_agent_tpu.envs.doom import tools
+
+        frames = [np.full((4, 6, 3), i, np.uint8) for i in range(3)]
+        grid = tools.concat_grid(frames)
+        assert grid.shape == (8, 12, 3)
+        assert (grid[:4, :6] == 0).all() and (grid[:4, 6:] == 1).all()

@@ -8,6 +8,7 @@ loop trains through the kill.
 """
 
 import functools
+import time
 
 import jax
 import numpy as np
@@ -88,8 +89,24 @@ class TestWorkerRespawn:
             envs.initial()
             envs._procs[0].kill()
             envs._procs[0].join(timeout=5)
-            with pytest.raises(RemoteEnvError, match="respawn budget"):
+            with pytest.raises(RemoteEnvError, match="crash-looping"):
                 envs.step(np.zeros((4,), np.int64))
+        finally:
+            envs.close()
+
+    def test_deaths_outside_window_do_not_exhaust_budget(self):
+        """The budget detects crash loops, not lifetime faults: deaths
+        older than respawn_window_s fall out of the window."""
+        envs = make_envs(max_respawns=1)
+        envs.respawn_window_s = 0.2
+        try:
+            for _ in range(3):  # 3 deaths, each in its own window
+                envs.initial()
+                envs._procs[0].kill()
+                envs._procs[0].join(timeout=5)
+                envs.step(np.zeros((4,), np.int64))  # respawns, no raise
+                time.sleep(0.25)
+            assert envs.total_respawns == 3
         finally:
             envs.close()
 
