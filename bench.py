@@ -61,6 +61,14 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _core_impl() -> str:
+    """One policy for every bench agent (all bench meshes are
+    single-device): parallel/mesh.py fused_kernels_profitable."""
+    from scalable_agent_tpu.parallel.mesh import fused_kernels_profitable
+
+    return "pallas" if fused_kernels_profitable(num_devices=1) else "xla"
+
+
 def _probe_backend():
     """Try default (TPU) backend init in a subprocess — a hung tunnel must
     not hang the bench.  Returns (info_dict | None, error | None)."""
@@ -155,7 +163,8 @@ def bench_learner(result, diag):
     num_actions, repeats = 9, 4
     frames_per_update = batch * unroll_len * repeats
 
-    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16)
+    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16,
+                        core_impl=_core_impl())
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
                       frames_per_update=frames_per_update)
@@ -290,7 +299,8 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
         "inference_mode": "accum",
     }
 
-    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16)
+    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16,
+                        core_impl=_core_impl())
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
                       frames_per_update=frames_per_update)
@@ -387,7 +397,8 @@ def bench_ingraph(diag, budget_s=90.0):
     num_actions, repeats = 9, 4
     frames_per_update = batch * unroll_len * repeats
 
-    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16)
+    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16,
+                        core_impl=_core_impl())
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
                       frames_per_update=frames_per_update)

@@ -56,6 +56,10 @@ class Config:
     # -- TPU-native knobs (no reference equivalent)
     torso_type: str = "shallow"  # shallow | resnet
     compute_dtype: str = "bfloat16"  # conv compute dtype on TPU
+    # LSTM core: auto | xla | pallas — auto picks the fused Pallas
+    # unroll (ops/lstm_pallas.py) on a single-device TPU mesh, the
+    # nn.scan path elsewhere.  Param trees are identical either way.
+    core_impl: str = "auto"
     use_instruction: bool = False
     # (the actor-group count is derived: num_actors // batch_size — each
     # group is one learner batch; >= 2 groups overlap env-sim with TPU
@@ -72,7 +76,9 @@ class Config:
     # "service" (C++ dynamic batcher co-batches groups into one call —
     # the reference's architecture, dynamic_batching.py + batcher.cc).
     inference_mode: str = "structural"
-    scan_impl: str = "associative"  # vtrace: associative | sequential | pallas
+    # vtrace: auto | associative | sequential | pallas — auto picks the
+    # fused Pallas kernel on a single-device TPU mesh, associative else.
+    scan_impl: str = "auto"
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5
     log_interval_s: float = 10.0

@@ -66,6 +66,18 @@ def env_kwargs(config: Config, name: Optional[str] = None) -> dict:
     return {}
 
 
+def resolve_core_impl(config: Config) -> str:
+    """"auto" defers to the shared fused-kernel policy
+    (parallel/mesh.py fused_kernels_profitable), sized from the config's
+    intended mesh (the agent is built before the mesh exists)."""
+    if config.core_impl != "auto":
+        return config.core_impl
+    num = (len(jax.devices()) if config.mesh_data == 0
+           else config.mesh_data * config.mesh_model)
+    from scalable_agent_tpu.parallel.mesh import fused_kernels_profitable
+    return "pallas" if fused_kernels_profitable(num_devices=num) else "xla"
+
+
 def build_agent(config: Config, action_space) -> ImpalaAgent:
     """Policy heads derive from the probed action space — one Discrete
     head or a composite tuple-categorical (ops/distributions.py)."""
@@ -74,6 +86,7 @@ def build_agent(config: Config, action_space) -> ImpalaAgent:
         torso_type=config.torso_type,
         use_instruction=config.use_instruction,
         compute_dtype=jnp.dtype(config.compute_dtype),
+        core_impl=resolve_core_impl(config),
     )
 
 

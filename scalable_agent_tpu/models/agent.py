@@ -27,7 +27,7 @@ from flax import linen as nn
 
 from scalable_agent_tpu.models.instruction import InstructionEncoder
 from scalable_agent_tpu.models.networks import TORSOS
-from scalable_agent_tpu.ops import distributions
+from scalable_agent_tpu.ops import distributions, lstm_pallas
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -66,6 +66,71 @@ class _CoreStep(nn.Module):
         return new_carry, y
 
 
+class _GateParams(nn.Module):
+    """One gate's kernel (+bias), mirroring the param tree that
+    ``flax.linen.OptimizedLSTMCell`` builds via its DenseParams
+    children — same names, shapes, and initializers, so both core
+    implementations share one checkpoint format."""
+
+    features: int
+    in_features: int
+    use_bias: bool
+    kernel_init: Any
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel", self.kernel_init,
+                            (self.in_features, self.features))
+        bias = (self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,))
+                if self.use_bias else None)
+        return kernel, bias
+
+
+class _PallasCoreParams(nn.Module):
+    """Declares the 8 OptimizedLSTMCell gate params (ii/if/ig/io input
+    kernels, hi/hf/hg/ho recurrent kernels + biases) and returns them
+    concatenated as (Wi [D,4H], Wh [H,4H], b [4H]) in (i,f,g,o) order —
+    the layout ops/lstm_pallas.lstm_unroll consumes."""
+
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self):
+        ks_i, ks_h, bs = [], [], []
+        for comp in "ifgo":
+            k, _ = _GateParams(
+                self.features, self.in_features, False,
+                nn.initializers.lecun_normal(), name=f"i{comp}")()
+            ks_i.append(k)
+            k, b = _GateParams(
+                self.features, self.features, True,
+                nn.initializers.orthogonal(), name=f"h{comp}")()
+            ks_h.append(k)
+            bs.append(b)
+        return (jnp.concatenate(ks_i, axis=-1),
+                jnp.concatenate(ks_h, axis=-1),
+                jnp.concatenate(bs, axis=-1))
+
+
+class _PallasCore(nn.Module):
+    """The fused Pallas done-reset LSTM unroll (ops/lstm_pallas.py),
+    parameter-compatible with the ``nn.scan(_CoreStep)`` path: both
+    produce params under core/lstm/{ii..ho}."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, carry, x, done):
+        wi, wh, b = _PallasCoreParams(
+            self.features, x.shape[-1], name="lstm")()
+        ys, (ct, ht) = lstm_pallas.lstm_unroll(
+            jnp.asarray(x, jnp.float32), done, carry[0], carry[1],
+            wi, wh, b, jax.default_backend() != "tpu")
+        return (ct, ht), ys
+
+
 class ImpalaAgent(nn.Module):
     """ConvNet/ResNet torso + LSTM(256) core + policy/baseline heads.
 
@@ -84,6 +149,10 @@ class ImpalaAgent(nn.Module):
     use_instruction: bool = False
     core_size: int = CORE_SIZE
     compute_dtype: Any = jnp.float32
+    # LSTM core implementation: "xla" = nn.scan over OptimizedLSTMCell;
+    # "pallas" = the fused single-program unroll (ops/lstm_pallas.py).
+    # Parameter trees are identical, so checkpoints are interchangeable.
+    core_impl: str = "xla"
     # Composite policies: a TupleSpace mixing Discrete/Discretized
     # components (reference: TupleActionDistribution,
     # algorithms/utils/action_distributions.py:111-201).  When unset, the
@@ -144,16 +213,23 @@ class ImpalaAgent(nn.Module):
 
         # ---- LSTM core: one fused scan over time with done-reset
         # (reference: experiment.py:228-237).
-        scan = nn.scan(
-            _CoreStep,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=0,
-            out_axes=0,
-        )
         carry = (core_state.c, core_state.h)
-        carry, core_outputs = scan(self.core_size, name="core")(
-            carry, (torso_out, jnp.asarray(done, jnp.float32)))
+        done_f32 = jnp.asarray(done, jnp.float32)
+        if self.core_impl == "pallas":
+            carry, core_outputs = _PallasCore(self.core_size, name="core")(
+                carry, torso_out, done_f32)
+        elif self.core_impl == "xla":
+            scan = nn.scan(
+                _CoreStep,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0,
+                out_axes=0,
+            )
+            carry, core_outputs = scan(self.core_size, name="core")(
+                carry, (torso_out, done_f32))
+        else:
+            raise ValueError(f"unknown core_impl: {self.core_impl!r}")
         new_state = AgentState(c=carry[0], h=carry[1])
 
         # ---- Heads (reference: _head, experiment.py:200-210), again on the

@@ -88,3 +88,25 @@ def model_parallel_shardings(mesh: Mesh, tree):
         return NamedSharding(mesh, PartitionSpec())
 
     return jax.tree_util.tree_map(shard, tree)
+
+
+def fused_kernels_profitable(mesh: Optional[Mesh] = None,
+                             num_devices: Optional[int] = None) -> bool:
+    """THE policy behind every ``"auto"`` kernel choice (Learner
+    scan_impl, Config/driver core_impl, bench): the fused Pallas kernels
+    (ops/vtrace_pallas.py, ops/lstm_pallas.py) win only on a
+    single-device TPU mesh — ``pallas_call`` has no SPMD partitioning
+    rule, so a multi-device mesh would replicate the call (correct but
+    wasteful), and non-TPU backends only have the interpreter.
+
+    Pass the actual ``mesh`` when one exists; ``num_devices`` when only
+    the intended mesh size is known (e.g. from Config before the mesh is
+    built); neither to ask about the whole process.
+    """
+    if jax.default_backend() != "tpu":
+        return False
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        return mesh.devices.size == 1
+    if num_devices is None:
+        num_devices = len(jax.devices())
+    return num_devices == 1
