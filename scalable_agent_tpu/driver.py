@@ -15,6 +15,7 @@ import argparse
 import dataclasses
 import functools
 import json
+import math
 import os
 import queue as queue_lib
 import threading
@@ -66,14 +67,36 @@ def env_kwargs(config: Config, name: Optional[str] = None) -> dict:
     return {}
 
 
+def resolve_mesh_data(config: Config) -> int:
+    """The data-axis size train() will actually use — shared by the
+    mesh construction and every "auto" kernel-choice estimate so they
+    can never disagree."""
+    n_devices = len(jax.devices())
+    if jax.process_count() > 1:
+        # Multi-host meshes must span EVERY process's devices: a
+        # truncated device list would exclude whole processes, whose
+        # local batch shards then have no addressable home in
+        # make_array_from_process_local_data.
+        mesh_data = config.mesh_data or n_devices // config.mesh_model
+        if mesh_data * config.mesh_model != n_devices:
+            raise ValueError(
+                f"multi-host mesh (data={mesh_data}, "
+                f"model={config.mesh_model}) must cover all "
+                f"{n_devices} global devices")
+        return mesh_data
+    # The batch axis shards over 'data': pick the largest data-axis
+    # size that divides the batch (a 4-batch debug run on an
+    # 8-device mesh uses 4 of them rather than failing).
+    return config.mesh_data or math.gcd(config.batch_size, n_devices)
+
+
 def resolve_core_impl(config: Config) -> str:
     """"auto" defers to the shared fused-kernel policy
-    (parallel/mesh.py fused_kernels_profitable), sized from the config's
-    intended mesh (the agent is built before the mesh exists)."""
+    (parallel/mesh.py fused_kernels_profitable), sized from the mesh
+    train() will build (the agent is built before the mesh exists)."""
     if config.core_impl != "auto":
         return config.core_impl
-    num = (len(jax.devices()) if config.mesh_data == 0
-           else config.mesh_data * config.mesh_model)
+    num = resolve_mesh_data(config) * config.mesh_model
     from scalable_agent_tpu.parallel.mesh import fused_kernels_profitable
     return "pallas" if fused_kernels_profitable(num_devices=num) else "xla"
 
@@ -234,26 +257,7 @@ def train(config: Config) -> Dict[str, float]:
     observation_spec, action_space = probe_env(config)
     agent = build_agent(config, action_space)
 
-    import math
-
-    n_devices = len(jax.devices())
-    if jax.process_count() > 1:
-        # Multi-host meshes must span EVERY process's devices: a
-        # truncated device list would exclude whole processes, whose
-        # local batch shards then have no addressable home in
-        # make_array_from_process_local_data.
-        mesh_data = config.mesh_data or n_devices // config.mesh_model
-        if mesh_data * config.mesh_model != n_devices:
-            raise ValueError(
-                f"multi-host mesh (data={mesh_data}, "
-                f"model={config.mesh_model}) must cover all "
-                f"{n_devices} global devices")
-    else:
-        # The batch axis shards over 'data': pick the largest data-axis
-        # size that divides the batch (a 4-batch debug run on an
-        # 8-device mesh uses 4 of them rather than failing).
-        mesh_data = config.mesh_data or math.gcd(
-            config.batch_size, n_devices)
+    mesh_data = resolve_mesh_data(config)
     if config.batch_size % mesh_data:
         raise ValueError(
             f"batch_size {config.batch_size} not divisible by data-axis "

@@ -60,6 +60,36 @@ def log_probs_from_logits_and_actions(policy_logits, actions):
     return jnp.take_along_axis(log_pi, actions[..., None], axis=-1).squeeze(-1)
 
 
+def elementwise_prologue(log_rhos, discounts, rewards, values,
+                         bootstrap_value, clip_rho_threshold):
+    """The V-trace elementwise pre-computation shared by every
+    recurrence implementation (single-device scans here, the Pallas
+    kernel's host-side wrapper, and the time-sharded path in
+    parallel/sequence.py): returns (a, deltas, rhos, values_t_plus_1)
+    where acc solves acc_s = deltas_s + a_s * acc_{s+1}."""
+    rhos = jnp.exp(log_rhos)
+    if clip_rho_threshold is not None:
+        clipped_rhos = jnp.minimum(jnp.float32(clip_rho_threshold), rhos)
+    else:
+        clipped_rhos = rhos
+    cs = jnp.minimum(jnp.float32(1.0), rhos)
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
+    return discounts * cs, deltas, rhos, values_t_plus_1
+
+
+def elementwise_epilogue(rhos, discounts, rewards, values, vs_t_plus_1,
+                         clip_pg_rho_threshold):
+    """The shared pg-advantage computation given vs_{t+1}."""
+    if clip_pg_rho_threshold is not None:
+        clipped_pg_rhos = jnp.minimum(
+            jnp.float32(clip_pg_rho_threshold), rhos)
+    else:
+        clipped_pg_rhos = rhos
+    return clipped_pg_rhos * (rewards + discounts * vs_t_plus_1 - values)
+
+
 def _linear_recurrence_reverse(a, b, scan_impl: str):
     """Solve acc_s = b_s + a_s * acc_{s+1} with acc_T = 0, over axis 0.
 
@@ -144,27 +174,16 @@ def from_importance_weights(
             vs=lax.stop_gradient(vs.reshape(shape)),
             pg_advantages=lax.stop_gradient(pg.reshape(shape)))
 
-    rhos = jnp.exp(log_rhos)
-    if clip_rho_threshold is not None:
-        clipped_rhos = jnp.minimum(jnp.float32(clip_rho_threshold), rhos)
-    else:
-        clipped_rhos = rhos
-
-    cs = jnp.minimum(jnp.float32(1.0), rhos)
-    values_t_plus_1 = jnp.concatenate(
-        [values[1:], bootstrap_value[None]], axis=0)
-    deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
-
-    vs_minus_v_xs = _linear_recurrence_reverse(discounts * cs, deltas, scan_impl)
+    a, deltas, rhos, _ = elementwise_prologue(
+        log_rhos, discounts, rewards, values, bootstrap_value,
+        clip_rho_threshold)
+    vs_minus_v_xs = _linear_recurrence_reverse(a, deltas, scan_impl)
     vs = vs_minus_v_xs + values
 
     vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
-    if clip_pg_rho_threshold is not None:
-        clipped_pg_rhos = jnp.minimum(jnp.float32(clip_pg_rho_threshold), rhos)
-    else:
-        clipped_pg_rhos = rhos
-    pg_advantages = clipped_pg_rhos * (
-        rewards + discounts * vs_t_plus_1 - values)
+    pg_advantages = elementwise_epilogue(
+        rhos, discounts, rewards, values, vs_t_plus_1,
+        clip_pg_rho_threshold)
 
     return VTraceReturns(
         vs=lax.stop_gradient(vs),

@@ -1,9 +1,13 @@
 from scalable_agent_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
+    fused_kernels_profitable,
     make_mesh,
     model_parallel_shardings,
     replicated_sharding,
+)
+from scalable_agent_tpu.parallel.sequence import (
+    from_importance_weights_sharded,
 )
 from scalable_agent_tpu.parallel.distributed import (
     initialize_distributed,

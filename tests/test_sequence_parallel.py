@@ -1,0 +1,71 @@
+"""Sequence-parallel V-trace: time-sharded recurrence == single-device.
+
+SURVEY §5.7 promised the V-trace scan shardable over a mesh axis; this
+proves it end-to-end on an 8-virtual-device CPU mesh (conftest sets
+XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from scalable_agent_tpu.ops import vtrace
+from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+from scalable_agent_tpu.parallel.sequence import (
+    from_importance_weights_sharded,
+)
+
+
+def make_inputs(seq_len, batch, seed=0):
+    rng = np.random.RandomState(seed)
+    return dict(
+        log_rhos=rng.uniform(-2.5, 2.5, (seq_len, batch)).astype(np.float32),
+        discounts=(rng.uniform(0, 1, (seq_len, batch)) * 0.95)
+        .astype(np.float32),
+        rewards=rng.standard_normal((seq_len, batch)).astype(np.float32),
+        values=rng.standard_normal((seq_len, batch)).astype(np.float32),
+        bootstrap_value=rng.standard_normal((batch,)).astype(np.float32),
+    )
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_time_sharded_matches_single_device(shards):
+    mesh = make_mesh(MeshSpec(data=shards, model=1),
+                     devices=jax.devices()[:shards])
+    inputs = make_inputs(96, 5)
+    ref = vtrace.from_importance_weights(scan_impl="associative", **inputs)
+    out = from_importance_weights_sharded(mesh, seq_axis="data", **inputs)
+    np.testing.assert_allclose(
+        np.asarray(out.vs), np.asarray(ref.vs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out.pg_advantages), np.asarray(ref.pg_advantages),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_time_sharded_no_clipping_and_jit():
+    mesh = make_mesh(MeshSpec(data=4, model=1), devices=jax.devices()[:4])
+    inputs = make_inputs(64, 3, seed=1)
+    ref = vtrace.from_importance_weights(
+        clip_rho_threshold=None, clip_pg_rho_threshold=None, **inputs)
+
+    @jax.jit
+    def fn(log_rhos, discounts, rewards, values, bootstrap_value):
+        return from_importance_weights_sharded(
+            mesh, log_rhos, discounts, rewards, values, bootstrap_value,
+            clip_rho_threshold=None, clip_pg_rho_threshold=None,
+            seq_axis="data")
+
+    out = fn(**inputs)
+    np.testing.assert_allclose(
+        np.asarray(out.vs), np.asarray(ref.vs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out.pg_advantages), np.asarray(ref.pg_advantages),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_uneven_split_raises():
+    mesh = make_mesh(MeshSpec(data=4, model=1), devices=jax.devices()[:4])
+    inputs = make_inputs(10, 2)  # 10 % 4 != 0
+    with pytest.raises(ValueError, match="divide evenly"):
+        from_importance_weights_sharded(mesh, seq_axis="data", **inputs)
