@@ -86,8 +86,10 @@ def resolve_mesh_data(config: Config) -> int:
         return mesh_data
     # The batch axis shards over 'data': pick the largest data-axis
     # size that divides the batch (a 4-batch debug run on an
-    # 8-device mesh uses 4 of them rather than failing).
-    return config.mesh_data or math.gcd(config.batch_size, n_devices)
+    # 8-device mesh uses 4 of them rather than failing), out of the
+    # devices left after the model axis takes its share.
+    return config.mesh_data or math.gcd(
+        config.batch_size, max(1, n_devices // config.mesh_model))
 
 
 def resolve_core_impl(config: Config) -> str:

@@ -140,6 +140,9 @@ class DoomEnv(Environment):
         scenarios_dir: Optional[str] = None,
         async_mode: bool = False,
         record_to: Optional[str] = None,
+        coord_limits=None,
+        max_histogram_length: int = 200,
+        show_automap: bool = False,
     ):
         self.action_space = action_space
         self.config_path = resolve_scenario_path(config_file, scenarios_dir)
@@ -163,6 +166,29 @@ class DoomEnv(Environment):
         self.is_multiplayer = False
         self.bot_difficulty_mean = None
         self.bot_difficulty_std = 10
+
+        # Positional-coverage histogram (reference: doom_gym.py:102-117,
+        # 424-438): pass coord_limits=(x0, y0, x1, y1) to track where
+        # the agent has been, aspect-scaled to max_histogram_length
+        # bins on the longer side.  Needs POSITION_X/POSITION_Y among
+        # available_game_variables.
+        self.coord_limits = coord_limits
+        self.max_histogram_length = int(max_histogram_length)
+        self.current_histogram = self.previous_histogram = None
+        if coord_limits:
+            x = coord_limits[2] - coord_limits[0]
+            y = coord_limits[3] - coord_limits[1]
+            if x > y:
+                len_x = self.max_histogram_length
+                len_y = max(1, int(y / x * self.max_histogram_length))
+            else:
+                len_y = self.max_histogram_length
+                len_x = max(1, int(x / y * self.max_histogram_length))
+            self.current_histogram = np.zeros((len_x, len_y), np.int32)
+            self.previous_histogram = np.zeros_like(self.current_histogram)
+
+        # Engine top-down view (reference: doom_gym.py:171-189).
+        self.show_automap = show_automap
 
     # -- spec --------------------------------------------------------------
 
@@ -199,6 +225,20 @@ class DoomEnv(Environment):
         game.set_window_visible(False)
         game.set_mode(vizdoom.Mode.ASYNC_PLAYER if self.async_mode
                       else vizdoom.Mode.PLAYER)
+        if self.show_automap:
+            # Object-level top-down map, centered, fixed orientation
+            # (reference: doom_gym.py:171-189).
+            game.set_automap_buffer_enabled(True)
+            game.set_automap_mode(vizdoom.AutomapMode.OBJECTS)
+            game.set_automap_rotate(False)
+            game.set_automap_render_textures(False)
+            game.add_game_args("+viz_am_center 1")
+            game.add_game_args("+am_backcolor ffffff")
+            game.add_game_args("+am_tswallcolor dddddd")
+            game.add_game_args("+am_yourcolor ffffff")
+            game.add_game_args("+am_cheat 0")
+            game.add_game_args("+am_thingcolor 0000ff")
+            game.add_game_args("+am_thingcolor_item 00ff00")
         self._customize_game(game)
         game.init()
         return game
@@ -240,6 +280,32 @@ class DoomEnv(Environment):
             return dict(self._prev_info)
         return dict(variables)
 
+    def _update_histogram(self, info: Dict[str, float], eps: float = 1e-8):
+        """Bin the agent's (x, y) into the coverage histogram
+        (reference: doom_gym.py:424-438)."""
+        if self.current_histogram is None:
+            return
+        if "POSITION_X" not in info or "POSITION_Y" not in info:
+            return
+        x0, y0, x1, y1 = self.coord_limits
+        dx = (info["POSITION_X"] - x0) / (x1 - x0)
+        dy = (info["POSITION_Y"] - y0) / (y1 - y0)
+        ix = int((dx - eps) * self.current_histogram.shape[0])
+        iy = int((dy - eps) * self.current_histogram.shape[1])
+        ix = min(max(ix, 0), self.current_histogram.shape[0] - 1)
+        iy = min(max(iy, 0), self.current_histogram.shape[1] - 1)
+        self.current_histogram[ix, iy] += 1
+
+    def get_automap_buffer(self) -> Optional[np.ndarray]:
+        """HWC automap frame, or None once the episode finished
+        (reference: doom_gym.py:415-422)."""
+        if self.game is None or self.game.is_episode_finished():
+            return None
+        state = self.game.get_state()
+        if state is None or state.automap_buffer is None:
+            return None
+        return np.transpose(np.asarray(state.automap_buffer), (1, 2, 0))
+
     def _fix_bugged_variables(self, info: Dict[str, float]):
         """Subtract previous-episode values of counters VizDoom fails to
         reset on new_episode (reference: doom_gym.py:310-319)."""
@@ -264,6 +330,9 @@ class DoomEnv(Environment):
         self._last_episode_info = dict(self._prev_info)
         self._prev_info = {}
         self._num_episodes += 1
+        if self.current_histogram is not None:
+            self.previous_histogram = self.current_histogram.copy()
+            self.current_histogram.fill(0)
         frame = (self._frame_from_state(state) if state is not None
                  else self._black_screen())
         return make_observation(frame)
@@ -279,6 +348,7 @@ class DoomEnv(Environment):
             variables = self._variables_dict(state)
             info.update(self.get_info(variables))
             self._prev_info = dict(info)
+            self._update_histogram(info)
         else:
             frame = self._black_screen()
             # done=True forbids get_state; report the last live info

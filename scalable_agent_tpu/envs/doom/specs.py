@@ -120,6 +120,8 @@ def assemble_doom_env(
     async_mode: bool = False,
     env: Optional[DoomEnv] = None,
     num_bots: Optional[int] = None,
+    coord_limits=None,
+    show_automap: bool = False,
 ):
     """The single-player wrapper pipeline (reference:
     doom_utils.py:141-217): recording -> multiplayer stats -> bot
@@ -130,7 +132,9 @@ def assemble_doom_env(
         env = DoomEnv(spec.action_space, spec.config_file,
                       skip_frames=skip_frames,
                       scenarios_dir=scenarios_dir,
-                      async_mode=async_mode)
+                      async_mode=async_mode,
+                      coord_limits=coord_limits,
+                      show_automap=show_automap)
     bots = spec.num_bots if num_bots is None else num_bots
     wrapped = env
     if record_to is not None:

@@ -368,3 +368,52 @@ def set_doom_resolution(env: DoomRewardShaping, resolution: str):
     width, height = (int(part) for part in resolution.split("x"))
     env.unwrapped.set_resolution(width, height, f"RES_{width}X{height}")
     log.debug("Doom native resolution set to %s", resolution)
+
+
+class DoomExplorationWrapper(Wrapper):
+    """Landmark-based exploration bonus (reference: wrappers/
+    exploration.py:10-58): a pose (x, y, view angle) farther than
+    ``threshold`` from every stored landmark — Euclidean distance plus
+    half the wrapped angular difference — earns ``bonus`` intrinsic
+    reward and becomes a landmark itself.  The bonus is surfaced via
+    ``info['intrinsic_reward']`` and NOT added to the env reward,
+    matching the reference; landmarks are randomly evicted past
+    ``max_landmarks`` and cleared on reset.
+    """
+
+    def __init__(self, env: Environment, max_landmarks: int = 200,
+                 threshold: float = 75.0, bonus: float = 0.1,
+                 seed: int = 0):
+        super().__init__(env)
+        self.max_landmarks = int(max_landmarks)
+        self.threshold = float(threshold)
+        self.bonus = float(bonus)
+        self._landmarks = []
+        self._rng = np.random.default_rng(seed)
+
+    def _intrinsic_reward(self, info: Dict) -> float:
+        if "POSITION_X" not in info or "POSITION_Y" not in info:
+            return 0.0
+        x, y = info["POSITION_X"], info["POSITION_Y"]
+        angle = info.get("ANGLE", 0.0)
+        for lx, ly, la in self._landmarks:
+            angle_diff = abs(angle - la)
+            angle_diff = min(angle_diff, 360.0 - angle_diff)
+            distance = np.hypot(x - lx, y - ly) + angle_diff / 2.0
+            if distance < self.threshold:
+                return 0.0
+        self._landmarks.append((x, y, angle))
+        while len(self._landmarks) > self.max_landmarks:
+            del self._landmarks[int(self._rng.integers(
+                0, len(self._landmarks)))]
+        return self.bonus
+
+    def reset(self):
+        self._landmarks = []
+        return self.env.reset()
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        info["intrinsic_reward"] = (
+            info.get("intrinsic_reward", 0.0) + self._intrinsic_reward(info))
+        return obs, reward, done, info

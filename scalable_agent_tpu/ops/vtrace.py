@@ -60,6 +60,17 @@ def log_probs_from_logits_and_actions(policy_logits, actions):
     return jnp.take_along_axis(log_pi, actions[..., None], axis=-1).squeeze(-1)
 
 
+def compose_affine(later, earlier):
+    """Affine-map composition for the reverse recurrence, shared by the
+    single-device associative scan and the time-sharded path
+    (parallel/sequence.py).  With reverse=True, associative_scan folds
+    later timesteps into the left operand; composing
+    f_earlier ∘ f_later gives (a_e * a_l, b_e + a_e * b_l)."""
+    a_l, b_l = later
+    a_e, b_e = earlier
+    return a_e * a_l, b_e + a_e * b_l
+
+
 def elementwise_prologue(log_rhos, discounts, rewards, values,
                          bootstrap_value, clip_rho_threshold):
     """The V-trace elementwise pre-computation shared by every
@@ -109,15 +120,7 @@ def _linear_recurrence_reverse(a, b, scan_impl: str):
     if scan_impl != "associative":
         raise ValueError(f"unknown scan_impl: {scan_impl!r}")
 
-    def compose(later, earlier):
-        # With reverse=True, associative_scan folds later timesteps into the
-        # left operand; composing f_earlier ∘ f_later gives
-        # (a_e * a_l, b_e + a_e * b_l).
-        a_l, b_l = later
-        a_e, b_e = earlier
-        return a_e * a_l, b_e + a_e * b_l
-
-    _, acc = lax.associative_scan(compose, (a, b), reverse=True)
+    _, acc = lax.associative_scan(compose_affine, (a, b), reverse=True)
     return acc
 
 

@@ -32,17 +32,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from scalable_agent_tpu.ops.vtrace import (
     VTraceReturns,
+    compose_affine,
     elementwise_epilogue,
     elementwise_prologue,
 )
-
-
-def _compose(later, earlier):
-    """Affine-map composition for the reverse recurrence (matches
-    ops/vtrace.py _linear_recurrence_reverse)."""
-    a_l, b_l = later
-    a_e, b_e = earlier
-    return a_e * a_l, b_e + a_e * b_l
 
 
 def _chunk_recurrence(a, b, axis_name):
@@ -55,18 +48,17 @@ def _chunk_recurrence(a, b, axis_name):
     # Composed suffix maps within the chunk: (A_s, B_s) such that
     # acc_s = B_s + A_s * x where x is the accumulator just past the
     # chunk end.
-    comp_a, comp_b = lax.associative_scan(_compose, (a, b), reverse=True)
+    comp_a, comp_b = lax.associative_scan(compose_affine, (a, b), reverse=True)
 
     # One composed pair per shard (its first element composes the whole
     # chunk); gather S of them and fold the suffix on every shard.
     all_a = lax.all_gather(comp_a[0], axis_name)    # [S, B...]
     all_b = lax.all_gather(comp_b[0], axis_name)
-    num_shards = all_a.shape[0]
 
     # suffix[j] = (f_j o f_{j+1} o ... o f_{S-1})(0): reverse scan over
     # the shard axis (S is tiny — this is S log S work on [B] vectors).
     _, suffix = lax.associative_scan(
-        _compose, (all_a, all_b), reverse=True, axis=0)
+        compose_affine, (all_a, all_b), reverse=True, axis=0)
     # boundary for shard j = acc at the first element of shard j+1
     # = suffix[j+1], with suffix[S] = 0.
     suffix_padded = jnp.concatenate(

@@ -24,9 +24,10 @@ EPISODE_TICS = int(os.environ.get("FAKE_VIZDOOM_EPISODE_TICS", "64"))
 
 
 class _State:
-    def __init__(self, screen_buffer, game_variables):
+    def __init__(self, screen_buffer, game_variables, automap_buffer=None):
         self.screen_buffer = screen_buffer
         self.game_variables = game_variables
+        self.automap_buffer = automap_buffer
 
 
 class ScreenResolution:
@@ -41,6 +42,14 @@ for _res in ("160X120", "200X125", "200X150", "256X144", "320X240",
 class Mode:
     PLAYER = "PLAYER"
     ASYNC_PLAYER = "ASYNC_PLAYER"
+    SPECTATOR = "SPECTATOR"
+
+
+class AutomapMode:
+    NORMAL = "NORMAL"
+    WHOLE = "WHOLE"
+    OBJECTS = "OBJECTS"
+    OBJECTS_WITH_SIZE = "OBJECTS_WITH_SIZE"
 
 
 def _variable_value(name: str, tic: int) -> float:
@@ -71,6 +80,10 @@ def _variable_value(name: str, tic: int) -> float:
         return float(tic // 8 - player)
     if name == "DEAD":
         return 0.0
+    if name == "POSITION_X":
+        return float((tic * 13) % 100)
+    if name == "POSITION_Y":
+        return float((tic * 29) % 50)
     return float(abs(hash(name)) % 10)
 
 
@@ -90,6 +103,10 @@ class DoomGame:
         self.episode = 0
         self._last_reward = 0.0
         self._pending_action = None
+        self.automap_enabled = False
+        self.automap_mode = None
+        self.automap_rotate = None
+        self.automap_textures = None
 
     # -- config ------------------------------------------------------------
 
@@ -146,7 +163,20 @@ class DoomGame:
             return None
         variables = [_variable_value(name, self.tic)
                      for name in self.variable_names]
-        return _State(self._frame(), variables)
+        automap = self._frame() if self.automap_enabled else None
+        return _State(self._frame(), variables, automap)
+
+    def set_automap_buffer_enabled(self, enabled):
+        self.automap_enabled = bool(enabled)
+
+    def set_automap_mode(self, mode):
+        self.automap_mode = mode
+
+    def set_automap_rotate(self, rotate):
+        self.automap_rotate = bool(rotate)
+
+    def set_automap_render_textures(self, textures):
+        self.automap_textures = bool(textures)
 
     # -- stepping ----------------------------------------------------------
 
