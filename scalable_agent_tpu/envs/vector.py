@@ -27,6 +27,7 @@ import numpy as np
 from scalable_agent_tpu.envs.worker import (
     _CLOSE,
     _INITIAL,
+    _PREDICT,
     _STEP,
     RemoteEnvError,
     _dumps_exception,
@@ -112,6 +113,8 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
                     actions = request[1]
                     conn.send((True, run_all(
                         lambda i, stream: stream.step(actions[i]))))
+                elif kind == _PREDICT:
+                    conn.send((True, _predict_all(streams, request[1])))
                 elif kind == _CLOSE:
                     break
                 else:
@@ -135,6 +138,43 @@ def _maybe_stack(items: List) -> Optional[np.ndarray]:
     if not items or items[0] is None:
         return None
     return np.stack(items)
+
+
+def _predict_all(streams, actions):
+    """Speculative one-step lookahead (reference: multi_env.py:118-147):
+    each candidate action steps a ``deepcopy`` of the real stream, so
+    the real env state is untouched.  Returns per-(env, candidate)
+    frames/rewards/dones; clones are discarded immediately."""
+    import copy
+
+    frames, rewards, dones = [], [], []
+    for i, stream in enumerate(streams):
+        fr, rw, dn = [], [], []
+        for action in actions[i]:
+            if hasattr(stream, "clone"):
+                clone = stream.clone()
+            else:
+                try:
+                    clone = copy.deepcopy(stream)
+                except Exception as exc:
+                    raise RuntimeError(
+                        "predict() needs clone-capable envs: the stream "
+                        "is not deepcopy-able and has no clone() hook "
+                        "(native-handle simulators like VizDoom cannot "
+                        "be cloned)") from exc
+            out = clone.step(action)
+            fr.append(out.observation.frame)
+            rw.append(np.float32(out.reward))
+            dn.append(bool(out.done))
+            try:
+                clone.close()
+            except Exception:
+                pass
+        frames.append(np.stack(fr))
+        rewards.append(rw)
+        dones.append(dn)
+    return (np.stack(frames), np.asarray(rewards, np.float32),
+            np.asarray(dones, bool))
 
 
 class MultiEnv:
@@ -358,6 +398,68 @@ class MultiEnv:
     def step(self, actions) -> StepOutput:
         self.step_send(actions)
         return self.step_recv()
+
+    def _respawn_and_prime(self, w: int) -> None:
+        """Respawn a dead worker AND start its streams' fresh episodes
+        (its slice of the slab gets the initial frames), so the next
+        real step() finds initialized streams."""
+        self._respawn_worker(w)
+        self._conns[w].send((_INITIAL,))
+        ok, payload = self._conns[w].recv()
+        if not ok:
+            raise pickle.loads(payload)
+
+    def predict(self, imagined_action_lists):
+        """Speculative one-step lookahead over candidate actions
+        (reference: multi_env.py:118-147, 314-342 ``predict``):
+        ``imagined_action_lists`` holds K candidate actions per env;
+        each steps a deep-copied clone of the real env, leaving real
+        state untouched.  Returns (frames [N, K, H, W, C],
+        rewards [N, K], dones [N, K]).  Frames travel over the pipe,
+        not the slab — the slab still holds the last REAL step."""
+        if self._pending:
+            raise RuntimeError(
+                "predict() between step_send and step_recv would "
+                "desynchronize the worker pipes; finish the step first")
+        actions = np.asarray(imagined_action_lists)
+        if actions.shape[0] != self.num_envs:
+            raise ValueError(
+                f"got {actions.shape[0]} action lists for "
+                f"{self.num_envs} envs")
+        sent = []
+        for w, sl in enumerate(self._slices):
+            try:
+                self._conns[w].send((_PREDICT, actions[sl]))
+                sent.append(w)
+            except (BrokenPipeError, OSError):
+                # Respawn so the REAL pipeline stays healthy, but don't
+                # fabricate speculative results from a fresh episode —
+                # the caller sees the failure and may retry.
+                self._respawn_and_prime(w)
+        frames, rewards, dones = [], [], []
+        errors = ([] if len(sent) == len(self._conns) else
+                  [RemoteEnvError("env worker died before predict; "
+                                  "respawned — retry the call")])
+        for w in sent:
+            try:
+                ok, payload = self._conns[w].recv()
+            except (EOFError, OSError):
+                self._respawn_and_prime(w)
+                errors.append(RemoteEnvError(
+                    f"env worker {w} died during predict; respawned — "
+                    f"retry the call"))
+                continue
+            if not ok:
+                errors.append(pickle.loads(payload))
+                continue
+            f, r, d = payload
+            frames.append(f)
+            rewards.append(r)
+            dones.append(d)
+        if errors:
+            raise errors[0]
+        return (np.concatenate(frames), np.concatenate(rewards),
+                np.concatenate(dones))
 
     def frame_slab(self) -> np.ndarray:
         """Zero-copy [N, H, W, C] view (valid until the next step)."""

@@ -36,6 +36,7 @@ _STEP = 0
 _INITIAL = 1
 _CLOSE = 2
 _SPECS = 3
+_PREDICT = 4  # speculative lookahead (vector.py MultiEnv.predict)
 
 
 class RemoteEnvError(RuntimeError):

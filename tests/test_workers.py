@@ -164,3 +164,76 @@ class TestMultiEnv:
             assert out.reward.shape == (5,)
         finally:
             vec.close()
+
+
+class TestPredict:
+    """Speculative one-step lookahead (reference: multi_env.py:118-147):
+    deep-copied clones step candidate actions; real state is
+    untouched."""
+
+    def _make(self, n, workers):
+        fns = [functools.partial(make_impala_stream, "fake_small", seed=i)
+               for i in range(n)]
+        return MultiEnv(fns, FRAME_SPEC, num_workers=workers)
+
+    def test_predict_shapes_and_real_state_untouched(self):
+        n, k = 4, 3
+        vec = self._make(n, workers=2)
+        try:
+            vec.initial()
+            vec.step(np.zeros((n,), np.int64))
+            slab_before = vec.frame_slab().copy()
+
+            candidates = np.tile(np.arange(k), (n, 1))
+            frames, rewards, dones = vec.predict(candidates)
+            assert frames.shape == (n, k, 16, 16, 3)
+            assert rewards.shape == (n, k) and dones.shape == (n, k)
+
+            # the real slab is unchanged, and the next REAL step matches
+            # what the same action predicted from the same state
+            np.testing.assert_array_equal(vec.frame_slab(), slab_before)
+            out = vec.step(np.full((n,), 2, np.int64))
+            np.testing.assert_array_equal(
+                out.observation.frame, frames[:, 2])
+            np.testing.assert_allclose(out.reward, rewards[:, 2])
+        finally:
+            vec.close()
+
+    def test_predict_wrong_count_raises(self):
+        vec = self._make(2, workers=1)
+        try:
+            vec.initial()
+            with pytest.raises(ValueError, match="action lists"):
+                vec.predict(np.zeros((3, 2), np.int64))
+        finally:
+            vec.close()
+
+    def test_predict_during_pending_step_raises(self):
+        vec = self._make(2, workers=1)
+        try:
+            vec.initial()
+            vec.step_send(np.zeros((2,), np.int64))
+            with pytest.raises(RuntimeError, match="desynchronize"):
+                vec.predict(np.zeros((2, 2), np.int64))
+            vec.step_recv()  # protocol still in sync
+        finally:
+            vec.close()
+
+    def test_predict_worker_death_respawns_and_raises(self):
+        from scalable_agent_tpu.envs.worker import RemoteEnvError
+
+        vec = self._make(4, workers=2)
+        try:
+            vec.initial()
+            vec._procs[0].kill()
+            vec._procs[0].join(timeout=5)
+            with pytest.raises(RemoteEnvError, match="retry"):
+                vec.predict(np.zeros((4, 2), np.int64))
+            # the respawned worker is primed: real stepping continues
+            out = vec.step(np.zeros((4,), np.int64))
+            assert out.observation.frame.shape == (4, 16, 16, 3)
+            # and a retry of the speculative call now succeeds
+            frames, _, _ = vec.predict(np.zeros((4, 2), np.int64))
+            assert frames.shape == (4, 2, 16, 16, 3)
+        finally:
+            vec.close()
