@@ -102,18 +102,35 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
                     _maybe_stack(instructions),
                     _maybe_stack(measurements))
 
+        # A freshly (re)spawned worker has never started its episodes.
+        # Auto-priming here means the PARENT never has to eagerly reset
+        # a respawned worker: the first _STEP after a respawn returns
+        # initial outputs (done=True, episode_step=0 — the visible
+        # episode boundary), and _PREDICT quietly starts the episodes
+        # it is about to clone.
+        initialized = False
         while True:
             request = conn.recv()
             kind = request[0]
             try:
                 if kind == _INITIAL:
+                    initialized = True
                     conn.send((True, run_all(
                         lambda i, stream: stream.initial())))
                 elif kind == _STEP:
-                    actions = request[1]
-                    conn.send((True, run_all(
-                        lambda i, stream: stream.step(actions[i]))))
+                    if initialized:
+                        actions = request[1]
+                        conn.send((True, run_all(
+                            lambda i, stream: stream.step(actions[i]))))
+                    else:
+                        initialized = True
+                        conn.send((True, run_all(
+                            lambda i, stream: stream.initial())))
                 elif kind == _PREDICT:
+                    if not initialized:
+                        for stream in streams:
+                            stream.initial()
+                        initialized = True
                     conn.send((True, _predict_all(streams, request[1])))
                 elif kind == _CLOSE:
                     break
@@ -162,14 +179,16 @@ def _predict_all(streams, actions):
                         "is not deepcopy-able and has no clone() hook "
                         "(native-handle simulators like VizDoom cannot "
                         "be cloned)") from exc
-            out = clone.step(action)
-            fr.append(out.observation.frame)
-            rw.append(np.float32(out.reward))
-            dn.append(bool(out.done))
             try:
-                clone.close()
-            except Exception:
-                pass
+                out = clone.step(action)
+                fr.append(out.observation.frame)
+                rw.append(np.float32(out.reward))
+                dn.append(bool(out.done))
+            finally:
+                try:
+                    clone.close()
+                except Exception:
+                    pass
         frames.append(np.stack(fr))
         rewards.append(rw)
         dones.append(dn)
@@ -399,16 +418,6 @@ class MultiEnv:
         self.step_send(actions)
         return self.step_recv()
 
-    def _respawn_and_prime(self, w: int) -> None:
-        """Respawn a dead worker AND start its streams' fresh episodes
-        (its slice of the slab gets the initial frames), so the next
-        real step() finds initialized streams."""
-        self._respawn_worker(w)
-        self._conns[w].send((_INITIAL,))
-        ok, payload = self._conns[w].recv()
-        if not ok:
-            raise pickle.loads(payload)
-
     def predict(self, imagined_action_lists):
         """Speculative one-step lookahead over candidate actions
         (reference: multi_env.py:118-147, 314-342 ``predict``):
@@ -426,28 +435,36 @@ class MultiEnv:
             raise ValueError(
                 f"got {actions.shape[0]} action lists for "
                 f"{self.num_envs} envs")
-        sent = []
+        # Dead workers are recorded during the fan-out and respawned
+        # only after every healthy worker has its request (the call
+        # already ends in an error; don't stall the others' lookahead
+        # behind a multi-second respawn).  Respawned workers are NOT
+        # eagerly reset — the worker auto-primes on its next request,
+        # so the slab keeps the last REAL frames and the episode
+        # boundary (done=True) surfaces on the next real step.
+        sent, dead = [], []
         for w, sl in enumerate(self._slices):
             try:
                 self._conns[w].send((_PREDICT, actions[sl]))
                 sent.append(w)
             except (BrokenPipeError, OSError):
-                # Respawn so the REAL pipeline stays healthy, but don't
-                # fabricate speculative results from a fresh episode —
-                # the caller sees the failure and may retry.
-                self._respawn_and_prime(w)
+                dead.append(w)
+        for w in dead:
+            self._respawn_worker(w)
         frames, rewards, dones = [], [], []
-        errors = ([] if len(sent) == len(self._conns) else
-                  [RemoteEnvError("env worker died before predict; "
-                                  "respawned — retry the call")])
+        errors = [RemoteEnvError(
+            f"env worker {w} died before predict; respawned (its envs "
+            f"restart on the next step) — retry the call")
+            for w in dead]
         for w in sent:
             try:
                 ok, payload = self._conns[w].recv()
             except (EOFError, OSError):
-                self._respawn_and_prime(w)
+                self._respawn_worker(w)
                 errors.append(RemoteEnvError(
-                    f"env worker {w} died during predict; respawned — "
-                    f"retry the call"))
+                    f"env worker {w} died during predict; respawned "
+                    f"(its envs restart on the next step) — retry the "
+                    f"call"))
                 continue
             if not ok:
                 errors.append(pickle.loads(payload))

@@ -74,6 +74,14 @@ class InGraphTrainer:
         self._unroll_length = unroll_length
         self._batch = batch
         self._seed = int(seed)
+        # Shard the rollout over the learner's data axis: one constraint
+        # on the carry propagates through the scan, so env transitions
+        # and agent inference compute on their batch shard's device
+        # (PartitionSpec("data") shards axis 0 at any rank).
+        from scalable_agent_tpu.parallel.mesh import batch_sharding
+
+        self._batch_sharding = batch_sharding(
+            learner._mesh, batch_axis_index=0)
         self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
 
     # -- initialization ----------------------------------------------------
@@ -129,9 +137,16 @@ class InGraphTrainer:
         )
         return trajectory, new_carry
 
+    def _constrain_batch(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x if x is None or getattr(x, "ndim", 0) == 0
+            else jax.lax.with_sharding_constraint(x, self._batch_sharding),
+            tree, is_leaf=lambda x: x is None)
+
     def _fused(self, state, carry: RolloutCarry, counter):
         rng = jax.random.fold_in(
             jax.random.key(self._seed), counter)
+        carry = self._constrain_batch(carry)
         trajectory, new_carry = self._rollout(state.params, carry, rng)
         new_state, metrics = self._learner._update_impl(state, trajectory)
         return new_state, new_carry, metrics

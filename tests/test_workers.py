@@ -227,11 +227,17 @@ class TestPredict:
             vec.initial()
             vec._procs[0].kill()
             vec._procs[0].join(timeout=5)
+            slab_before = vec.frame_slab().copy()
             with pytest.raises(RemoteEnvError, match="retry"):
                 vec.predict(np.zeros((4, 2), np.int64))
-            # the respawned worker is primed: real stepping continues
+            # no eager reset: the slab still holds the last REAL frames
+            np.testing.assert_array_equal(vec.frame_slab(), slab_before)
+            # the respawned worker auto-primes on the next step, and the
+            # episode boundary is VISIBLE (done=True, step 0) for its
+            # slice (envs 0..1 live on the killed worker)
             out = vec.step(np.zeros((4,), np.int64))
-            assert out.observation.frame.shape == (4, 16, 16, 3)
+            assert bool(out.done[0]) and bool(out.done[1])
+            assert int(out.info.episode_step[0]) == 0
             # and a retry of the speculative call now succeeds
             frames, _, _ = vec.predict(np.zeros((4, 2), np.int64))
             assert frames.shape == (4, 2, 16, 16, 3)
