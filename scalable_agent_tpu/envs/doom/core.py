@@ -248,7 +248,60 @@ class DoomEnv(Environment):
 
     def _ensure_game(self):
         if self.game is None:
-            self.game = self._make_game()
+            self.game = self._init_serialized()
+
+    def _init_serialized(self):
+        """First game init, serialized ACROSS PROCESSES with a file
+        lock: many workers initializing VizDoom simultaneously race on
+        engine-side file extraction (reference: environments_doom.py:
+        46-57 — FileLock + 10s-timeout retry loop).  fcntl.flock keeps
+        it dependency-free.  Any environment where the lock cannot
+        work — no fcntl (non-POSIX), unwritable lock path (another
+        user's file), flock-unsupported filesystem — falls back to an
+        UNLOCKED init, which is exactly the pre-lock behavior.
+        """
+        import errno
+        import tempfile
+        import time
+
+        try:
+            import fcntl
+        except ImportError:
+            return self._make_game()
+        # Per-user path: /tmp is world-shared and another user's lock
+        # file would be unwritable.
+        lock_path = os.path.join(
+            tempfile.gettempdir(),
+            f"scalable_agent_tpu_doom_init_{os.getuid()}.lock")
+        try:
+            lock_file = open(lock_path, "a")
+        except OSError:
+            return self._make_game()
+        attempt = 0
+        with lock_file:
+            while True:
+                attempt += 1
+                try:
+                    fcntl.flock(lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError as exc:
+                    if exc.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                         errno.EACCES):
+                        # flock unsupported here (e.g. some NFS mounts):
+                        # don't spin forever on an error that will never
+                        # clear.
+                        return self._make_game()
+                    if attempt % 100 == 0:
+                        from scalable_agent_tpu.utils import log
+
+                        log.info(
+                            "another process holds the Doom init lock "
+                            "(attempt %d)", attempt)
+                    time.sleep(0.1)
+                    continue
+                try:
+                    return self._make_game()
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
 
     # -- helpers -----------------------------------------------------------
 

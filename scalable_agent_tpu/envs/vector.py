@@ -103,34 +103,40 @@ def _vec_worker_main(conn, make_streams_pickled: bytes, shm_name: str,
                     _maybe_stack(measurements))
 
         # A freshly (re)spawned worker has never started its episodes.
-        # Auto-priming here means the PARENT never has to eagerly reset
-        # a respawned worker: the first _STEP after a respawn returns
-        # initial outputs (done=True, episode_step=0 — the visible
-        # episode boundary), and _PREDICT quietly starts the episodes
-        # it is about to clone.
+        # Auto-priming on _STEP means the PARENT never has to eagerly
+        # reset a respawned worker: the first _STEP after a respawn
+        # returns initial outputs (done=True, episode_step=0 — the
+        # VISIBLE episode boundary).  _PREDICT refuses instead of
+        # quietly priming — lookahead from a silently restarted episode
+        # would splice into the caller's old-episode trajectory with no
+        # done flag.  The flag only flips after run_all succeeds, so a
+        # failed initial() leaves the worker honestly uninitialized.
         initialized = False
         while True:
             request = conn.recv()
             kind = request[0]
             try:
                 if kind == _INITIAL:
+                    payload = run_all(lambda i, stream: stream.initial())
                     initialized = True
-                    conn.send((True, run_all(
-                        lambda i, stream: stream.initial())))
+                    conn.send((True, payload))
                 elif kind == _STEP:
                     if initialized:
                         actions = request[1]
-                        conn.send((True, run_all(
-                            lambda i, stream: stream.step(actions[i]))))
+                        payload = run_all(
+                            lambda i, stream: stream.step(actions[i]))
                     else:
+                        payload = run_all(
+                            lambda i, stream: stream.initial())
                         initialized = True
-                        conn.send((True, run_all(
-                            lambda i, stream: stream.initial())))
+                    conn.send((True, payload))
                 elif kind == _PREDICT:
                     if not initialized:
-                        for stream in streams:
-                            stream.initial()
-                        initialized = True
+                        raise RuntimeError(
+                            "predict() on a freshly (re)started worker: "
+                            "its episodes have not begun — run a real "
+                            "step()/initial() first (the restart "
+                            "surfaces there as done=True)")
                     conn.send((True, _predict_all(streams, request[1])))
                 elif kind == _CLOSE:
                     break
@@ -435,13 +441,13 @@ class MultiEnv:
             raise ValueError(
                 f"got {actions.shape[0]} action lists for "
                 f"{self.num_envs} envs")
-        # Dead workers are recorded during the fan-out and respawned
-        # only after every healthy worker has its request (the call
-        # already ends in an error; don't stall the others' lookahead
-        # behind a multi-second respawn).  Respawned workers are NOT
-        # eagerly reset — the worker auto-primes on its next request,
-        # so the slab keeps the last REAL frames and the episode
-        # boundary (done=True) surfaces on the next real step.
+        # Dead workers are recorded during the fan-out, every healthy
+        # worker's reply is drained (keeping all pipes in sync even if
+        # a respawn later fails), and only then are the dead respawned
+        # — after which the first error propagates.  Respawned workers
+        # are NOT reset here: the slab keeps the last REAL frames, the
+        # worker refuses further predict()s until a real step, and the
+        # episode boundary (done=True) surfaces on that step.
         sent, dead = [], []
         for w, sl in enumerate(self._slices):
             try:
@@ -449,22 +455,12 @@ class MultiEnv:
                 sent.append(w)
             except (BrokenPipeError, OSError):
                 dead.append(w)
-        for w in dead:
-            self._respawn_worker(w)
-        frames, rewards, dones = [], [], []
-        errors = [RemoteEnvError(
-            f"env worker {w} died before predict; respawned (its envs "
-            f"restart on the next step) — retry the call")
-            for w in dead]
+        frames, rewards, dones, errors = [], [], [], []
         for w in sent:
             try:
                 ok, payload = self._conns[w].recv()
             except (EOFError, OSError):
-                self._respawn_worker(w)
-                errors.append(RemoteEnvError(
-                    f"env worker {w} died during predict; respawned "
-                    f"(its envs restart on the next step) — retry the "
-                    f"call"))
+                dead.append(w)
                 continue
             if not ok:
                 errors.append(pickle.loads(payload))
@@ -473,6 +469,12 @@ class MultiEnv:
             frames.append(f)
             rewards.append(r)
             dones.append(d)
+        for w in dead:
+            self._respawn_worker(w)
+            errors.append(RemoteEnvError(
+                f"env worker {w} died around predict; respawned (its "
+                f"envs restart at the next step, surfacing done=True) "
+                f"— step before retrying"))
         if errors:
             raise errors[0]
         return (np.concatenate(frames), np.concatenate(rewards),

@@ -447,3 +447,36 @@ class TestExplorationWrapper:
             assert info["intrinsic_reward"] == pytest.approx(0.1)
         finally:
             env.close()
+
+
+class TestInitLock:
+    def test_concurrent_init_serializes_and_succeeds(self):
+        """Two games initializing at once serialize on the cross-process
+        file lock and both come up (reference: environments_doom.py:
+        46-57 FileLock retry loop)."""
+        import threading
+
+        from scalable_agent_tpu.envs.doom.core import DoomEnv
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+
+        envs = [DoomEnv(doom_action_space_basic(), "basic.cfg")
+                for _ in range(2)]
+        errors = []
+
+        def init(env):
+            try:
+                env.reset()
+            except Exception as exc:  # noqa: BLE001 - collected for assert
+                errors.append(exc)
+
+        threads = [threading.Thread(target=init, args=(e,)) for e in envs]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            assert all(e.game is not None for e in envs)
+        finally:
+            for e in envs:
+                e.close()

@@ -243,3 +243,25 @@ class TestPredict:
             assert frames.shape == (4, 2, 16, 16, 3)
         finally:
             vec.close()
+
+    def test_predict_refused_until_restarted_worker_steps(self):
+        """After a mid-predict death, a predict retry BEFORE a real
+        step is refused — quiet re-priming would splice a hidden
+        episode restart into the caller's trajectory."""
+        from scalable_agent_tpu.envs.worker import RemoteEnvError
+
+        vec = self._make(4, workers=2)
+        try:
+            vec.initial()
+            vec._procs[0].kill()
+            vec._procs[0].join(timeout=5)
+            with pytest.raises(RemoteEnvError):
+                vec.predict(np.zeros((4, 2), np.int64))
+            with pytest.raises(RuntimeError, match="step"):
+                vec.predict(np.zeros((4, 2), np.int64))
+            out = vec.step(np.zeros((4,), np.int64))
+            assert bool(out.done[0])  # the visible boundary
+            frames, _, _ = vec.predict(np.zeros((4, 2), np.int64))
+            assert frames.shape == (4, 2, 16, 16, 3)
+        finally:
+            vec.close()
