@@ -377,6 +377,87 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
         thread.join(timeout=5)
 
 
+def bench_kernels(diag):
+    """Pallas-vs-XLA microbench of the two fused kernels (ops/
+    vtrace_pallas.py, ops/lstm_pallas.py) at production shapes; records
+    per-call timings in the diagnostics so each round's BENCH file
+    documents the kernel speedups measured on the real chip.  TPU only
+    — interpret mode on CPU would time the interpreter, not a kernel."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalable_agent_tpu.ops import vtrace
+    from scalable_agent_tpu.ops.lstm_pallas import lstm_unroll
+
+    if jax.default_backend() != "tpu":
+        return
+
+    def timed(fn, sync, iters=100):
+        sync(fn())
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn()
+        sync(out)
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    rng = np.random.RandomState(0)
+    T, B = 100, 256
+    vt = {k: jax.device_put(jnp.asarray(v)) for k, v in dict(
+        log_rhos=rng.uniform(-2.5, 2.5, (T, B)).astype(np.float32),
+        discounts=(rng.uniform(0, 1, (T, B)) * 0.99).astype(np.float32),
+        rewards=rng.standard_normal((T, B)).astype(np.float32),
+        values=rng.standard_normal((T, B)).astype(np.float32),
+        bootstrap_value=rng.standard_normal((B,)).astype(np.float32),
+    ).items()}
+    for impl in ("associative", "pallas"):
+        fn = jax.jit(functools.partial(
+            vtrace.from_importance_weights, scan_impl=impl))
+        diag[f"kernel_vtrace_{impl}_us"] = round(timed(
+            lambda: fn(**vt),
+            lambda out: float(np.asarray(out.vs).sum())), 1)
+
+    T, B, D, H = 100, 32, 266, 256
+    args = tuple(map(jnp.asarray, (
+        rng.standard_normal((T, B, D)).astype(np.float32),
+        (rng.random((T, B)) < 0.02).astype(np.float32),
+        np.zeros((B, H), np.float32), np.zeros((B, H), np.float32),
+        (rng.standard_normal((D, 4 * H)) * 0.05).astype(np.float32),
+        (rng.standard_normal((H, 4 * H)) * 0.05).astype(np.float32),
+        np.zeros((4 * H,), np.float32))))
+
+    def xla_unroll(x, done, c0, h0, wi, wh, b):
+        # stop_gradient matches the Pallas kernel's zero done-cotangent,
+        # so both variants do identical backward work.
+        done = jax.lax.stop_gradient(done)
+
+        def step(carry, td):
+            c, h = carry
+            xt, dt = td
+            keep = (1.0 - dt)[:, None]
+            c, h = keep * c, keep * h
+            gates = xt @ wi + h @ wh + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = (jax.nn.sigmoid(f) * c
+                     + jax.nn.sigmoid(i) * jnp.tanh(g))
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (c_new, h_new), h_new
+
+        (ct, ht), ys = jax.lax.scan(step, (c0, h0), (x, done))
+        return ys, (ct, ht)
+
+    for name, unroll in (("xla", xla_unroll),
+                         ("pallas", lambda *a: lstm_unroll(*a, False))):
+        vg = jax.jit(jax.value_and_grad(
+            lambda a: jnp.sum(unroll(*a)[0] ** 2)))
+        diag[f"kernel_lstm_grad_{name}_us"] = round(timed(
+            lambda: vg(args),
+            lambda out: float(np.asarray(out[0]))), 1)
+
+
 def bench_ingraph(diag, budget_s=90.0):
     """End-to-end fps of the fused in-graph path: rollout + update as one
     jitted program over the on-device benchmark env (runtime/ingraph.py).
@@ -534,6 +615,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_ingraph failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_kernels"
+    try:
+        bench_kernels(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_kernels failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()
 

@@ -173,6 +173,14 @@ class DoomMultiplayerEnv(DoomEnv):
                 info)
 
     def _ensure_game(self):
+        # DELIBERATELY bypasses the base class's cross-process init
+        # lock (core.py _init_serialized): a multiplayer match's games
+        # MUST initialize concurrently — the host's game.init() blocks
+        # until every joiner connects, so serializing them would
+        # deadlock the rendezvous.  Init races are covered by the
+        # wrapper's retry-with-kill loop instead (the reference makes
+        # the same trade: doom_multiagent_wrapper.py:225-273 retries,
+        # no FileLock on the multiplayer path).
         if self.game is None:
             try:
                 self.game = self._make_game()

@@ -450,14 +450,28 @@ class TestExplorationWrapper:
 
 
 class TestInitLock:
-    def test_concurrent_init_serializes_and_succeeds(self):
-        """Two games initializing at once serialize on the cross-process
-        file lock and both come up (reference: environments_doom.py:
-        46-57 FileLock retry loop)."""
+    def test_concurrent_init_critical_sections_do_not_overlap(self):
+        """Concurrent first-inits serialize on the file lock: the
+        _make_game critical sections must be disjoint in time, not just
+        both succeed (flock excludes between distinct fds, so two
+        threads observe the same mutual exclusion processes would).
+        (reference: environments_doom.py:46-57 FileLock retry loop)"""
         import threading
+        import time
+        from unittest import mock
 
         from scalable_agent_tpu.envs.doom.core import DoomEnv
         from scalable_agent_tpu.envs.doom import doom_action_space_basic
+
+        spans = []
+        orig = DoomEnv._make_game
+
+        def slow_make(self):
+            start = time.monotonic()
+            time.sleep(0.3)
+            game = orig(self)
+            spans.append((start, time.monotonic()))
+            return game
 
         envs = [DoomEnv(doom_action_space_basic(), "basic.cfg")
                 for _ in range(2)]
@@ -471,12 +485,17 @@ class TestInitLock:
 
         threads = [threading.Thread(target=init, args=(e,)) for e in envs]
         try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=30)
+            with mock.patch.object(DoomEnv, "_make_game", slow_make):
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30)
             assert not errors, errors
             assert all(e.game is not None for e in envs)
+            assert len(spans) == 2
+            first, second = sorted(spans)
+            assert second[0] >= first[1] - 0.01, (
+                f"init critical sections overlapped: {spans}")
         finally:
             for e in envs:
                 e.close()
