@@ -417,3 +417,82 @@ class DoomExplorationWrapper(Wrapper):
         info["intrinsic_reward"] = (
             info.get("intrinsic_reward", 0.0) + self._intrinsic_reward(info))
         return obs, reward, done, info
+
+
+def _null_action(space):
+    """A well-formed no-op for any composite action space (the human
+    step ignores it, but intermediate wrappers see a valid action)."""
+    from scalable_agent_tpu.envs.spaces import Box, TupleSpace
+
+    if isinstance(space, TupleSpace):
+        return tuple(_null_action(s) for s in space.spaces)
+    if isinstance(space, Box):
+        return np.zeros(space.shape, np.float32)
+    return 0
+
+
+class StepHumanInput(Wrapper):
+    """Human-driven stepping: the policy's action is IGNORED and the
+    game advances on the human's own input (the underlying DoomGame is
+    re-initialized into SPECTATOR mode with a visible window on first
+    use).  The human transition is substituted at the BASE env and then
+    flows out through the full wrapper chain, so resize / measurements /
+    reward shaping all apply exactly as they do to policy steps.
+    (reference: wrappers/step_human_input.py:7-38 — there via
+    mode='human' and a raw screen-buffer observation that bypassed the
+    pipeline; SPECTATOR is VizDoom's native mechanism.)
+    """
+
+    def __init__(self, env: Environment):
+        super().__init__(env)
+        self._spectator = False
+
+    def _ensure_spectator(self):
+        import vizdoom
+
+        base = self.unwrapped
+        # A closed/recreated game (base.game is None) loses the mode —
+        # re-arm spectator rather than trusting the stale flag.
+        if self._spectator and base.game is not None:
+            return
+        base._ensure_game()
+        game = base.game
+        game.close()
+        game.set_window_visible(True)
+        game.set_mode(vizdoom.Mode.SPECTATOR)
+        game.init()
+        self._spectator = True
+
+    def reset(self):
+        self._ensure_spectator()
+        return self.env.reset()
+
+    def step(self, action):
+        del action  # input comes from the human at the game window
+        self._ensure_spectator()
+        base = self.unwrapped
+        from scalable_agent_tpu.envs.core import make_observation
+
+        def human_step(_action):
+            game = base.game
+            game.advance_action()
+            done = game.is_episode_finished()
+            reward = game.get_last_reward()
+            info = {"num_frames": 1}
+            if not done:
+                state = game.get_state()
+                frame = base._frame_from_state(state)
+                info.update(base.get_info(base._variables_dict(state)))
+                base._prev_info = dict(info)
+            else:
+                frame = base._black_screen()
+                info.update(base._prev_info)
+            base._fix_bugged_variables(info)
+            return (make_observation(frame), np.float32(reward),
+                    bool(done), info)
+
+        base.step = human_step
+        try:
+            return self.env.step(_null_action(base.action_space))
+        finally:
+            del base.step  # restore the class method

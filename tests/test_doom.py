@@ -499,3 +499,61 @@ class TestInitLock:
         finally:
             for e in envs:
                 e.close()
+
+
+class TestStepHumanInput:
+    def test_ignores_policy_action_and_advances(self):
+        from scalable_agent_tpu.envs.doom.core import DoomEnv
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+        from scalable_agent_tpu.envs.doom.wrappers import StepHumanInput
+
+        env = StepHumanInput(
+            DoomEnv(doom_action_space_basic(), "basic.cfg"))
+        try:
+            env.reset()
+            game = env.unwrapped.game
+            assert game.mode == "SPECTATOR"
+            assert game.window_visible
+            tic_before = game.tic
+            obs, reward, done, info = env.step("not-even-an-action")
+            assert game.tic == tic_before + 1
+            assert obs.frame.shape == env.unwrapped.observation_spec.frame.shape
+            assert info["num_frames"] == 1
+            # the base env's normal step() is restored afterward
+            assert "step" not in vars(env.unwrapped)
+        finally:
+            env.close()
+
+    def test_human_step_flows_through_wrapper_pipeline(self):
+        """Human transitions must pass through resize/measurements/
+        shaping exactly like policy steps — same obs shape and fields
+        within one episode."""
+        from scalable_agent_tpu.envs.doom.specs import (
+            assemble_doom_env, doom_spec_by_name)
+        from scalable_agent_tpu.envs.doom.wrappers import StepHumanInput
+
+        env = StepHumanInput(
+            assemble_doom_env(doom_spec_by_name("doom_battle")))
+        try:
+            obs0 = env.reset()
+            obs, reward, done, info = env.step(None)
+            assert obs.frame.shape == obs0.frame.shape  # resized alike
+            assert obs.measurements is not None          # DoomAdditionalInput
+            assert obs.measurements.shape == obs0.measurements.shape
+        finally:
+            env.close()
+
+    def test_spectator_rearmed_after_game_recreation(self):
+        from scalable_agent_tpu.envs.doom.core import DoomEnv
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+        from scalable_agent_tpu.envs.doom.wrappers import StepHumanInput
+
+        env = StepHumanInput(
+            DoomEnv(doom_action_space_basic(), "basic.cfg"))
+        try:
+            env.reset()
+            env.unwrapped.close()  # game -> None
+            env.reset()
+            assert env.unwrapped.game.mode == "SPECTATOR"
+        finally:
+            env.close()
