@@ -390,11 +390,12 @@ class DoomEnv(Environment):
                  else self._black_screen())
         return make_observation(frame)
 
-    def step(self, action):
-        flattened = convert_actions(self.action_space, action)
-        reward = self.game.make_action(flattened, self.skip_frames)
+    def _post_action(self, reward, num_frames: int):
+        """Shared bookkeeping after the game advanced (by make_action
+        OR by a human in spectator mode): frame/info assembly, info
+        carry, histogram, stale-variable fix."""
         done = self.game.is_episode_finished()
-        info: Dict[str, float] = {"num_frames": self.skip_frames}
+        info: Dict[str, float] = {"num_frames": num_frames}
         if not done:
             state = self.game.get_state()
             frame = self._frame_from_state(state)
@@ -410,6 +411,18 @@ class DoomEnv(Environment):
         self._fix_bugged_variables(info)
         return (make_observation(frame), np.float32(reward), bool(done),
                 info)
+
+    def step(self, action):
+        flattened = convert_actions(self.action_space, action)
+        reward = self.game.make_action(flattened, self.skip_frames)
+        return self._post_action(reward, self.skip_frames)
+
+    def step_human(self):
+        """One transition driven by the human's own input (game in a
+        SPECTATOR mode); same bookkeeping as a policy step."""
+        self.game.advance_action()
+        reward = self.game.get_last_reward()
+        return self._post_action(reward, 1)
 
     def render(self, mode: str = "rgb_array"):
         state = self.game.get_state() if self.game is not None else None

@@ -110,8 +110,9 @@ def replay_demo(env_name: str, demo_path: str,
 
 
 def play_human(env_name: str = "doom_basic", episodes: int = 1) -> None:
-    """Interactive human play via VizDoom SPECTATOR mode (needs a
-    display; the human drives the VizDoom window directly).
+    """Interactive human play via VizDoom ASYNC_SPECTATOR mode (needs
+    a display; the engine runs real-time at 35 tics/s and the human
+    drives the VizDoom window directly).
 
     (reference: play_doom.py:8-18, doom_gym.py:465-542 — pynput
     keyboard capture there; SPECTATOR mode is VizDoom's native
@@ -119,7 +120,8 @@ def play_human(env_name: str = "doom_basic", episodes: int = 1) -> None:
     """
     import vizdoom
 
-    env, game = _reinit_game(env_name, vizdoom.Mode.SPECTATOR, visible=True)
+    env, game = _reinit_game(env_name, vizdoom.Mode.ASYNC_SPECTATOR,
+                             visible=True)
     try:
         for episode in range(episodes):
             game.new_episode()

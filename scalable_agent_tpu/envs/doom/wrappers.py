@@ -459,7 +459,10 @@ class StepHumanInput(Wrapper):
         game = base.game
         game.close()
         game.set_window_visible(True)
-        game.set_mode(vizdoom.Mode.SPECTATOR)
+        # ASYNC: the engine runs at real-time 35 tics/s on its own
+        # clock — sync SPECTATOR would only advance when the step loop
+        # polls, freezing the game under the human's hands.
+        game.set_mode(vizdoom.Mode.ASYNC_SPECTATOR)
         game.init()
         self._spectator = True
 
@@ -471,27 +474,10 @@ class StepHumanInput(Wrapper):
         del action  # input comes from the human at the game window
         self._ensure_spectator()
         base = self.unwrapped
-        from scalable_agent_tpu.envs.core import make_observation
-
-        def human_step(_action):
-            game = base.game
-            game.advance_action()
-            done = game.is_episode_finished()
-            reward = game.get_last_reward()
-            info = {"num_frames": 1}
-            if not done:
-                state = game.get_state()
-                frame = base._frame_from_state(state)
-                info.update(base.get_info(base._variables_dict(state)))
-                base._prev_info = dict(info)
-            else:
-                frame = base._black_screen()
-                info.update(base._prev_info)
-            base._fix_bugged_variables(info)
-            return (make_observation(frame), np.float32(reward),
-                    bool(done), info)
-
-        base.step = human_step
+        # Substitute the human transition at the base env so it flows
+        # out through the whole wrapper chain; the bookkeeping itself
+        # lives in DoomEnv.step_human (shared with policy steps).
+        base.step = lambda _action: base.step_human()
         try:
             return self.env.step(_null_action(base.action_space))
         finally:

@@ -43,6 +43,7 @@ class Mode:
     PLAYER = "PLAYER"
     ASYNC_PLAYER = "ASYNC_PLAYER"
     SPECTATOR = "SPECTATOR"
+    ASYNC_SPECTATOR = "ASYNC_SPECTATOR"
 
 
 class AutomapMode:

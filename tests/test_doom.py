@@ -512,7 +512,7 @@ class TestStepHumanInput:
         try:
             env.reset()
             game = env.unwrapped.game
-            assert game.mode == "SPECTATOR"
+            assert game.mode == "ASYNC_SPECTATOR"
             assert game.window_visible
             tic_before = game.tic
             obs, reward, done, info = env.step("not-even-an-action")
@@ -554,6 +554,22 @@ class TestStepHumanInput:
             env.reset()
             env.unwrapped.close()  # game -> None
             env.reset()
-            assert env.unwrapped.game.mode == "SPECTATOR"
+            assert env.unwrapped.game.mode == "ASYNC_SPECTATOR"
+        finally:
+            env.close()
+
+    def test_human_steps_update_position_histogram(self):
+        from scalable_agent_tpu.envs.doom.core import DoomEnv
+        from scalable_agent_tpu.envs.doom import doom_action_space_basic
+        from scalable_agent_tpu.envs.doom.wrappers import StepHumanInput
+
+        env = StepHumanInput(
+            DoomEnv(doom_action_space_basic(), "battle.cfg",
+                    coord_limits=(0.0, 0.0, 100.0, 50.0)))
+        try:
+            env.reset()
+            for _ in range(4):
+                env.step(None)
+            assert env.unwrapped.current_histogram.sum() == 4
         finally:
             env.close()
