@@ -116,10 +116,13 @@ def build_agent(config: Config, action_space) -> ImpalaAgent:
 
 
 def probe_env(config: Config):
-    """Open one env to read its specs, then tear it down."""
+    """Open one env to read (observation_spec, action_space,
+    num_agents), then tear it down.  num_agents > 1 marks a lockstep
+    multi-agent level (create_env returns a MultiAgentEnv there)."""
     env = create_env(config.level_name, **env_kwargs(config))
     try:
-        return env.observation_spec, env.action_space
+        return (env.observation_spec, env.action_space,
+                getattr(env, "num_agents", 1))
     finally:
         env.close()
 
@@ -160,16 +163,59 @@ def zero_trajectory(config: Config, observation_spec, agent: ImpalaAgent,
     )
 
 
-def make_env_groups(config: Config, frame_spec: TensorSpec
-                    ) -> List[MultiEnv]:
+def make_env_groups(config: Config, frame_spec: TensorSpec,
+                    num_agents: int = 1) -> List[MultiEnv]:
     """num_actors envs as groups of batch_size (each group = one learner
     batch; >= 2 groups so env simulation and TPU inference overlap).
 
     ``frame_spec`` is the PROBED post-wrapper spec — pipelines change the
     channel count (e.g. Atari's grayscale stack-4 emits [84, 84, 4]), so
-    the shared-memory slab layout cannot be assumed 3-channel."""
+    the shared-memory slab layout cannot be assumed 3-channel.
+
+    Multi-agent levels (``num_agents > 1``, from probe_env — e.g.
+    ``doom_dm``, where ``create_env`` returns a lockstep
+    ``MultiAgentEnv``, not an Environment) route to
+    ``MultiAgentVectorEnv`` groups — K matches x A agents per group,
+    each agent one batch slot (the role of the reference's
+    ``create_multi_env`` dispatch, envs/env_utils.py:6-20)."""
     group_size = config.group_size()
     num_groups = max(1, config.num_actors // group_size)
+
+    if num_agents > 1:
+        if config.benchmark_mode:
+            raise ValueError(
+                "benchmark_mode is not supported for multi-agent levels")
+        if group_size % num_agents:
+            raise ValueError(
+                f"batch_size {group_size} must be a multiple of the "
+                f"level's num_agents ({num_agents})")
+        from scalable_agent_tpu.envs.doom.multiplayer import (
+            DEFAULT_UDP_PORT,
+            MultiAgentVectorEnv,
+        )
+
+        matches = group_size // num_agents
+        # Per-match seed (player seeds derive from it) and DISJOINT
+        # port-search sequences: bases stride 1000 and every match's
+        # fallback increment is 1000 * total_matches, so match k only
+        # ever probes ports congruent to its own base (mod the stride)
+        # — concurrent group init can't race another match's host.
+        total_matches = num_groups * matches
+        return [
+            MultiAgentVectorEnv([
+                functools.partial(
+                    create_env, config.level_name,
+                    num_action_repeats=config.num_action_repeats,
+                    seed=config.seed * 100000 + g * 1000 + m,
+                    port_base=(DEFAULT_UDP_PORT
+                               + (g * matches + m) * 1000),
+                    port_increment=1000 * total_matches,
+                    **env_kwargs(config))
+                for m in range(matches)
+            ])
+            for g in range(num_groups)
+        ]
+
     groups = []
     for g in range(num_groups):
         fns = [
@@ -256,7 +302,7 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     if is_coordinator():
         config.save()
-    observation_spec, action_space = probe_env(config)
+    observation_spec, action_space, num_agents = probe_env(config)
     agent = build_agent(config, action_space)
 
     mesh_data = resolve_mesh_data(config)
@@ -294,7 +340,8 @@ def train(config: Config) -> Dict[str, float]:
     else:
         start_updates = 0
 
-    env_groups = make_env_groups(config, observation_spec.frame)
+    env_groups = make_env_groups(config, observation_spec.frame,
+                                 num_agents=num_agents)
     pool = ActorPool(agent, env_groups, config.unroll_length,
                      level_name=config.level_name, seed=config.seed,
                      inference_mode=config.inference_mode)
@@ -461,7 +508,11 @@ def test(config: Config) -> Dict[str, List[float]]:
 
     probe_config = (dataclasses.replace(config, level_name=level_names[0])
                     if suite else config)
-    observation_spec, action_space = probe_env(probe_config)
+    observation_spec, action_space, num_agents = probe_env(probe_config)
+    if num_agents > 1:
+        raise ValueError(
+            "multi-agent levels are not supported in eval mode "
+            "(the reference's eval path is single-agent too)")
     agent = build_agent(config, action_space)
 
     # Restore against a structure template so optimizer-state NamedTuples

@@ -419,10 +419,19 @@ class DoomEnv(Environment):
 
     def step_human(self):
         """One transition driven by the human's own input (game in a
-        SPECTATOR mode); same bookkeeping as a policy step."""
+        SPECTATOR mode); same bookkeeping as a policy step.  In ASYNC
+        modes the engine runs on its own clock, so num_frames is the
+        MEASURED tic delta, not an assumed 1."""
+        before_tic = self.game.get_episode_time()
+        before_reward = self.game.get_total_reward()
         self.game.advance_action()
-        reward = self.game.get_last_reward()
-        return self._post_action(reward, 1)
+        # Total-reward delta, not get_last_reward(): in ASYNC modes
+        # several tics elapse per poll and last-reward only covers the
+        # final one.
+        reward = self.game.get_total_reward() - before_reward
+        elapsed = max(1, int(self.game.get_episode_time())
+                      - int(before_tic))
+        return self._post_action(reward, elapsed)
 
     def render(self, mode: str = "rgb_array"):
         state = self.game.get_state() if self.game is not None else None

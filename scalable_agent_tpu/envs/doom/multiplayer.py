@@ -254,11 +254,13 @@ class MultiAgentEnv:
     INIT_ATTEMPTS = 25
 
     def __init__(self, num_agents: int, make_env_fn: Callable,
-                 skip_frames: int = 4, port_base: Optional[int] = None):
+                 skip_frames: int = 4, port_base: Optional[int] = None,
+                 port_increment: int = 1000):
         self.num_agents = num_agents
         self.skip_frames = skip_frames
         self._make_env_fn = make_env_fn
         self._port_base = port_base or DEFAULT_UDP_PORT
+        self._port_increment = port_increment
         self._workers: Optional[List[_PlayerWorker]] = None
         # Spaces probed from a throwaway player env — construction is
         # cheap because the game itself initializes lazily (reference
@@ -272,7 +274,8 @@ class MultiAgentEnv:
     # -- init with retry ---------------------------------------------------
 
     def _try_init_once(self) -> bool:
-        port = find_available_udp_port(self._port_base, increment=1000)
+        port = find_available_udp_port(self._port_base,
+                                       increment=self._port_increment)
         self._workers = [
             _PlayerWorker(i, self._make_env_fn)
             for i in range(self.num_agents)
@@ -489,12 +492,16 @@ def make_doom_multiplayer_env(
     num_bots: Optional[int] = None,
     num_humans: int = 0,
     port_base: Optional[int] = None,
+    port_increment: int = 1000,
+    seed: Optional[int] = None,
     **kwargs,
 ):
     """Multiplayer routing (reference: doom_utils.py:220-258): >1 agent
     builds the lockstep MultiAgentEnv (frameskip handled by the
     wrapper, so per-player envs run skip=1); exactly one agent (vs
-    bots) hosts a normal game and steps natively."""
+    bots) hosts a normal game and steps natively.  ``seed`` decorrelates
+    matches: player seeds derive from it, so two matches built with
+    different seeds play different games."""
     from scalable_agent_tpu.envs.doom.specs import assemble_doom_env
 
     agents = spec.num_agents if num_agents is None else num_agents
@@ -511,7 +518,7 @@ def make_doom_multiplayer_env(
             respawn_delay=spec.respawn_delay, port=port,
         )
         if player_id >= 0:  # probe envs (player_id=-1) skip seeding
-            base.seed(player_id * 10 + 1)
+            base.seed((seed or 0) * 100 + player_id * 10 + 1)
         return assemble_doom_env(
             spec, width=width, height=height, env=base, num_bots=bots,
             **kwargs)
@@ -519,6 +526,7 @@ def make_doom_multiplayer_env(
     if is_multiagent:
         return MultiAgentEnv(agents, make_player_env,
                              skip_frames=skip_frames,
-                             port_base=port_base)
+                             port_base=port_base,
+                             port_increment=port_increment)
     port = find_available_udp_port(port_base or DEFAULT_UDP_PORT)
     return make_player_env(0, port=port)

@@ -34,7 +34,6 @@ float32 — regardless of a bfloat16 torso, so parity holds exactly).
 """
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

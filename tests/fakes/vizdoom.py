@@ -203,6 +203,13 @@ class DoomGame:
     def advance_action(self, tics=1, update_state=True):
         self._advance(tics)
 
+    def get_episode_time(self):
+        return self.tic
+
+    def get_total_reward(self):
+        # fake: cumulative reward == 0.1 * tic count this episode
+        return 0.1 * self.tic
+
     def get_last_reward(self):
         return self._last_reward
 
