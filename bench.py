@@ -307,7 +307,7 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
     cfg = Config(level_name="fake_benchmark", height=height, width=width,
                  batch_size=group_size, unroll_length=unroll_len)
     from scalable_agent_tpu.driver import probe_env
-    obs_spec, _ = probe_env(cfg)
+    obs_spec, _, _ = probe_env(cfg)
     state = learner.init(
         jax.random.key(0),
         zero_trajectory(cfg, obs_spec, agent, batch=group_size))

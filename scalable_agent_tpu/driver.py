@@ -196,20 +196,25 @@ def make_env_groups(config: Config, frame_spec: TensorSpec,
 
         matches = group_size // num_agents
         # Per-match seed (player seeds derive from it) and DISJOINT
-        # port-search sequences: bases stride 1000 and every match's
-        # fallback increment is 1000 * total_matches, so match k only
-        # ever probes ports congruent to its own base (mod the stride)
-        # — concurrent group init can't race another match's host.
-        total_matches = num_groups * matches
+        # port-search sequences, both GLOBALLY unique across multi-host
+        # processes: the base stride shrinks as the global match count
+        # grows so every base stays under the 65535 UDP limit, and each
+        # match's fallback increment is stride * total, keeping every
+        # match's probes in its own residue class — concurrent group
+        # init (any host) can't race another match's host.
+        proc = jax.process_index()
+        total_global = num_groups * matches * jax.process_count()
+        stride = max(10, min(1000, 25000 // max(1, total_global)))
         return [
             MultiAgentVectorEnv([
                 functools.partial(
                     create_env, config.level_name,
                     num_action_repeats=config.num_action_repeats,
-                    seed=config.seed * 100000 + g * 1000 + m,
-                    port_base=(DEFAULT_UDP_PORT
-                               + (g * matches + m) * 1000),
-                    port_increment=1000 * total_matches,
+                    seed=(config.seed * 1000000 + proc * 100000
+                          + g * 1000 + m),
+                    port_base=(DEFAULT_UDP_PORT + stride * (
+                        proc * num_groups * matches + g * matches + m)),
+                    port_increment=stride * total_global,
                     **env_kwargs(config))
                 for m in range(matches)
             ])

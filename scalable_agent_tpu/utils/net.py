@@ -8,6 +8,8 @@ import socket
 
 
 def is_udp_port_available(port: int) -> bool:
+    if not 0 < port < 65536:
+        return False
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -19,8 +21,13 @@ def is_udp_port_available(port: int) -> bool:
 
 def find_available_udp_port(start_port: int, increment: int = 1000) -> int:
     """First available UDP port in start + k*increment (reference:
-    envs/doom/multiplayer/doom_multiagent.py:16-22)."""
+    envs/doom/multiplayer/doom_multiagent.py:16-22).  Raises instead of
+    returning an out-of-range port."""
     port = start_port
-    while port < 65535 and not is_udp_port_available(port):
+    while port < 65536:
+        if is_udp_port_available(port):
+            return port
         port += increment
-    return port
+    raise RuntimeError(
+        f"no available UDP port in {start_port} + k*{increment} "
+        f"below 65536")

@@ -1,0 +1,63 @@
+"""Unit tests for the small utility modules the bigger suites only
+exercise indirectly: decay schedules, the UDP port probe (reference:
+utils/tests/test_utils.py:6-8), and the ffmpeg GIF encoder (skipped
+when ffmpeg is absent)."""
+
+import shutil
+import socket
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.utils.decay import LinearDecay
+from scalable_agent_tpu.utils.net import (
+    find_available_udp_port,
+    is_udp_port_available,
+)
+
+
+class TestLinearDecay:
+    def test_interpolation_and_clamping(self):
+        decay = LinearDecay([(0, 1.0), (100, 0.0)])
+        assert decay.at(-5) == 1.0
+        assert decay.at(0) == 1.0
+        assert decay.at(50) == pytest.approx(0.5)
+        assert decay.at(100) == 0.0
+        assert decay.at(1000) == 0.0
+
+    def test_multiple_segments(self):
+        decay = LinearDecay([(0, 0.0), (10, 1.0), (30, 0.5)])
+        assert decay.at(5) == pytest.approx(0.5)
+        assert decay.at(20) == pytest.approx(0.75)
+
+    def test_staircase_quantizes(self):
+        decay = LinearDecay([(0, 0.0), (100, 1.0)], staircase=4)
+        # fractions quantize to {0, .25, .5, .75}
+        assert decay.at(10) == pytest.approx(0.0)
+        assert decay.at(30) == pytest.approx(0.25)
+        assert decay.at(99) == pytest.approx(0.75)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            LinearDecay([])
+
+
+class TestUdpProbe:
+    def test_bound_port_unavailable_and_probe_skips_it(self):
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+            assert not is_udp_port_available(port)
+            assert find_available_udp_port(port, increment=1) != port
+        # released: available again
+        assert is_udp_port_available(port)
+
+
+@pytest.mark.skipif(shutil.which("ffmpeg") is None,
+                    reason="ffmpeg not installed")
+def test_encode_gif_produces_gif_bytes():
+    from scalable_agent_tpu.utils.gifs import encode_gif
+
+    frames = [np.full((8, 8, 3), i * 40, np.uint8) for i in range(4)]
+    data = encode_gif(frames, fps=5)
+    assert data[:6] in (b"GIF87a", b"GIF89a")
