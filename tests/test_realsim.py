@@ -21,6 +21,21 @@ def _has(module: str) -> bool:
         return False
 
 
+def _has_scenario(config_file: str) -> bool:
+    """Stock vizdoom ships only its own scenarios; Sample-Factory ones
+    (battle.cfg, ssl2.cfg, ...) need $DOOM_SCENARIOS_DIR — skip, don't
+    error, when they're absent."""
+    if not _has("vizdoom"):
+        return False
+    from scalable_agent_tpu.envs.doom.core import resolve_scenario_path
+
+    try:
+        resolve_scenario_path(config_file)
+        return True
+    except FileNotFoundError:
+        return False
+
+
 realsim = pytest.mark.realsim
 
 
@@ -87,7 +102,8 @@ def test_real_vizdoom_episode():
 
 
 @realsim
-@pytest.mark.skipif(not _has("vizdoom"), reason="vizdoom not installed")
+@pytest.mark.skipif(not _has_scenario("battle.cfg"),
+                    reason="vizdoom or battle.cfg scenario not available")
 def test_real_vizdoom_composite_battle():
     """The composite-action seam: tuple actions -> flattened buttons."""
     from scalable_agent_tpu.envs import create_env
@@ -100,5 +116,56 @@ def test_real_vizdoom_composite_battle():
             obs, reward, done, info = env.step((1, 0, 1, 0, step % 11))
             if done:
                 break
+    finally:
+        env.close()
+
+
+@realsim
+@pytest.mark.skipif(not _has_scenario("battle.cfg"),
+                    reason="vizdoom or battle.cfg scenario not available")
+def test_real_vizdoom_histogram_and_automap():
+    """Round-3 features against the real engine: positional-coverage
+    histogram binning (needs POSITION_X/Y among the scenario's game
+    variables) and the automap buffer layout."""
+    from scalable_agent_tpu.envs.doom.core import DoomEnv
+    from scalable_agent_tpu.envs.doom import doom_action_space_basic
+
+    env = DoomEnv(doom_action_space_basic(), "battle.cfg",
+                  coord_limits=(-2000.0, -2000.0, 2000.0, 2000.0),
+                  show_automap=True)
+    try:
+        # Fail loudly if the scenario stops declaring positions — the
+        # histogram silently no-ops without them.
+        assert "POSITION_X" in env.variable_indices, env.variable_indices
+        env.reset()
+        _, _, done, _ = env.step((1, 0))
+        if done:
+            pytest.skip("episode ended on the first step")
+        assert env.current_histogram.sum() > 0
+        automap = env.get_automap_buffer()
+        assert automap is not None
+        assert automap.ndim == 3 and automap.shape[2] == 3
+    finally:
+        env.close()
+
+
+@realsim
+@pytest.mark.skipif(not _has_scenario("ssl2.cfg"),
+                    reason="vizdoom or ssl2.cfg scenario not available")
+def test_real_vizdoom_multiagent_match():
+    """Real UDP host/join rendezvous: one 2-player lockstep match steps
+    and tears down (the seam the hermetic fake cannot validate)."""
+    from scalable_agent_tpu.envs import create_env
+
+    env = create_env("doom_duel", num_action_repeats=4)
+    try:
+        obs = env.reset()
+        assert len(obs) == 2
+        for step in range(5):
+            # doom_duel: full-discretized 7-component space, last is
+            # Discretized(21) turning (index 10 = no turn)
+            obs, rewards, dones, infos = env.step(
+                [(step % 3, 0, 0, 0, 0, 0, 10)] * 2)
+            assert len(rewards) == 2
     finally:
         env.close()
