@@ -102,8 +102,9 @@ def test_real_vizdoom_episode():
 
 
 @realsim
-@pytest.mark.skipif(not _has_scenario("battle.cfg"),
-                    reason="vizdoom or battle.cfg scenario not available")
+@pytest.mark.skipif(
+    not _has_scenario("battle_continuous_turning.cfg"),
+    reason="vizdoom or the doom_battle scenario not available")
 def test_real_vizdoom_composite_battle():
     """The composite-action seam: tuple actions -> flattened buttons."""
     from scalable_agent_tpu.envs import create_env
