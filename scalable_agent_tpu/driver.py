@@ -592,6 +592,12 @@ def test(config: Config) -> Dict[str, List[float]]:
 
 
 def main(argv: Optional[Sequence[str]] = None):
+    # Some interpreters pin jax to a platform via sitecustomize's
+    # jax.config, which silently overrides the standard JAX_PLATFORMS
+    # env var; restore the env var's contract for the CLI (a user
+    # setting JAX_PLATFORMS=cpu must get CPU, not a hung remote claim).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     parser = argparse.ArgumentParser(description=__doc__)
     for field in dataclasses.fields(Config):
         arg_type = type(field.default)

@@ -27,7 +27,7 @@ from flax import linen as nn
 
 from scalable_agent_tpu.models.instruction import InstructionEncoder
 from scalable_agent_tpu.models.networks import TORSOS
-from scalable_agent_tpu.ops import distributions, lstm_pallas
+from scalable_agent_tpu.ops import distributions
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -123,6 +123,10 @@ class _PallasCore(nn.Module):
 
     @nn.compact
     def __call__(self, carry, x, done):
+        # Lazy like vtrace.py's pallas path: XLA-only consumers never
+        # pay (or depend on) the Pallas TPU imports.
+        from scalable_agent_tpu.ops import lstm_pallas
+
         wi, wh, b = _PallasCoreParams(
             self.features, x.shape[-1], name="lstm")()
         ys, (ct, ht) = lstm_pallas.lstm_unroll(

@@ -157,3 +157,35 @@ class TestCoreImplCheckpointInterop:
             1 for line in open(os.path.join(config.logdir, "metrics.jsonl"))
             if "total_loss" in line)
         assert rows_after - rows_before == 1, (rows_before, rows_after)
+
+
+@pytest.mark.slow
+class TestCliSubprocess:
+    def test_main_module_trains(self, tmp_path):
+        """The exact user-facing command (`python -m
+        scalable_agent_tpu.driver --...`) runs a short hermetic train —
+        covering main()'s argparse bridge, not just train()."""
+        import subprocess
+        import sys
+
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.dirname(os.path.abspath(
+                    __file__)))] + os.environ.get(
+                        "PYTHONPATH", "").split(os.pathsep)),
+        )
+        logdir = tmp_path / "cli_run"
+        result = subprocess.run(
+            [sys.executable, "-m", "scalable_agent_tpu.driver",
+             "--mode=train", f"--logdir={logdir}",
+             "--level_name=fake_small", "--num_actors=4",
+             "--batch_size=2", "--unroll_length=4",
+             "--num_action_repeats=1", "--height=16", "--width=16",
+             "--total_environment_frames=16",
+             "--compute_dtype=float32", "--checkpoint_interval_s=1e9"],
+            env=env, capture_output=True, text=True, timeout=420)
+        assert result.returncode == 0, result.stderr[-2000:]
+        assert (logdir / "config.json").exists()
+        assert (logdir / "metrics.jsonl").exists()
