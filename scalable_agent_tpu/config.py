@@ -141,6 +141,11 @@ _ENV_OVERRIDES = {
     "doom_": {"width": 128, "height": 72, "num_action_repeats": 4},
     "atari_": {"width": 84, "height": 84, "num_action_repeats": 4},
     "dmlab_": {"width": 96, "height": 72, "num_action_repeats": 4},
+    # The full suite: DMLab defaults + instruction observations (the
+    # language levels need them; the reference's dmlab30 agent always
+    # consumes INSTR, experiment.py:179-189).
+    "dmlab30": {"width": 96, "height": 72, "num_action_repeats": 4,
+                "use_instruction": True},
 }
 
 

@@ -115,6 +115,19 @@ def build_agent(config: Config, action_space) -> ImpalaAgent:
     )
 
 
+def training_level_names(config: Config) -> List[str]:
+    """The level list training spreads env slots over.
+
+    ``--level_name=dmlab30 --mode=train`` is multi-task: env slot e runs
+    train level ``e % 30`` (the reference assigns actor i level
+    ``level_names[i % len]``, experiment.py:552-555, with the train-
+    variant list for train mode, :711-717).  Anything else trains one
+    level."""
+    if config.level_name == "dmlab30":
+        return [f"dmlab_{name}" for name in dmlab30.TRAIN_LEVELS]
+    return [config.level_name]
+
+
 def probe_env(config: Config):
     """Open one env to read (observation_spec, action_space,
     num_agents), then tear it down.  num_agents > 1 marks a lockstep
@@ -164,7 +177,9 @@ def zero_trajectory(config: Config, observation_spec, agent: ImpalaAgent,
 
 
 def make_env_groups(config: Config, frame_spec: TensorSpec,
-                    num_agents: int = 1) -> List[MultiEnv]:
+                    num_agents: int = 1,
+                    level_names: Optional[List[str]] = None
+                    ) -> List[MultiEnv]:
     """num_actors envs as groups of batch_size (each group = one learner
     batch; >= 2 groups so env simulation and TPU inference overlap).
 
@@ -180,8 +195,13 @@ def make_env_groups(config: Config, frame_spec: TensorSpec,
     ``create_multi_env`` dispatch, envs/env_utils.py:6-20)."""
     group_size = config.group_size()
     num_groups = max(1, config.num_actors // group_size)
+    level_names = level_names or [config.level_name]
 
     if num_agents > 1:
+        if len(level_names) > 1:
+            raise ValueError(
+                "multi-task training is not supported for multi-agent "
+                "levels")
         if config.benchmark_mode:
             raise ValueError(
                 "benchmark_mode is not supported for multi-agent levels")
@@ -204,16 +224,34 @@ def make_env_groups(config: Config, frame_spec: TensorSpec,
         # init (any host) can't race another match's host.
         proc = jax.process_index()
         total_global = num_groups * matches * jax.process_count()
-        stride = max(10, min(1000, 25000 // max(1, total_global)))
+        # Every match probes its own residue class (base + k*increment
+        # stays disjoint from other matches') AND must keep >= ~4 retry
+        # probes under 65536, so the stride shrinks with 8x headroom as
+        # the global match count grows.
+        stride = max(1, min(1000, 25000 // max(1, 8 * total_global)))
+        retries = (65536 - DEFAULT_UDP_PORT - stride * total_global) // (
+            stride * total_global)
+        if retries < 2:
+            raise ValueError(
+                f"{total_global} global matches do not fit the UDP port "
+                f"space above {DEFAULT_UDP_PORT} with retry headroom; "
+                f"reduce num_actors / batch_size or lower "
+                f"DOOM_DEFAULT_UDP_PORT")
+
+        def match_index(g: int, m: int) -> int:
+            return proc * num_groups * matches + g * matches + m
+
         return [
             MultiAgentVectorEnv([
                 functools.partial(
                     create_env, config.level_name,
                     num_action_repeats=config.num_action_repeats,
-                    seed=(config.seed * 1000000 + proc * 100000
-                          + g * 1000 + m),
-                    port_base=(DEFAULT_UDP_PORT + stride * (
-                        proc * num_groups * matches + g * matches + m)),
+                    # Non-overlapping seed fields: one globally-unique
+                    # match index scales the run seed, so no two matches
+                    # (any host) can derive the same per-player seeds.
+                    seed=config.seed * total_global + match_index(g, m),
+                    port_base=(DEFAULT_UDP_PORT
+                               + stride * match_index(g, m)),
                     port_increment=stride * total_global,
                     **env_kwargs(config))
                 for m in range(matches)
@@ -222,19 +260,31 @@ def make_env_groups(config: Config, frame_spec: TensorSpec,
         ]
 
     groups = []
+    # GLOBAL env slot (multi-host: each process owns a disjoint slot
+    # range) round-robins the level list so every level gets an equal
+    # share of actors across the whole job — per-host indexing would
+    # make every host train the same level prefix (reference assigns by
+    # global actor id, experiment.py:552-555).
+    slot_base = jax.process_index() * num_groups * group_size
     for g in range(num_groups):
+        labels = [
+            level_names[(slot_base + g * group_size + i)
+                        % len(level_names)]
+            for i in range(group_size)
+        ]
         fns = [
             functools.partial(
-                make_impala_stream, config.level_name,
+                make_impala_stream, labels[i],
                 seed=config.seed * 100000 + g * 1000 + i,
                 benchmark_mode=config.benchmark_mode,
                 num_action_repeats=config.num_action_repeats,
-                **env_kwargs(config))
+                **env_kwargs(config, labels[i]))
             for i in range(group_size)
         ]
         groups.append(MultiEnv(
             fns, frame_spec,
-            num_workers=config.num_env_workers_per_group))
+            num_workers=config.num_env_workers_per_group,
+            env_labels=labels))
     return groups
 
 
@@ -307,7 +357,11 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     if is_coordinator():
         config.save()
-    observation_spec, action_space, num_agents = probe_env(config)
+    level_names = training_level_names(config)
+    multi_task = len(level_names) > 1
+    probe_config = (dataclasses.replace(config, level_name=level_names[0])
+                    if multi_task else config)
+    observation_spec, action_space, num_agents = probe_env(probe_config)
     agent = build_agent(config, action_space)
 
     mesh_data = resolve_mesh_data(config)
@@ -346,7 +400,8 @@ def train(config: Config) -> Dict[str, float]:
         start_updates = 0
 
     env_groups = make_env_groups(config, observation_spec.frame,
-                                 num_agents=num_agents)
+                                 num_agents=num_agents,
+                                 level_names=level_names)
     pool = ActorPool(agent, env_groups, config.unroll_length,
                      level_name=config.level_name, seed=config.seed,
                      inference_mode=config.inference_mode)
@@ -373,6 +428,11 @@ def train(config: Config) -> Dict[str, float]:
     frames_at_last_log = frames
     metrics = {}
     completed = False
+    # Multi-task: per-level returns accumulated toward the TRAINING suite
+    # score, cleared after each score like the reference
+    # (experiment.py:652-667).
+    suite_returns: Dict[str, List[float]] = (
+        {name: [] for name in dmlab30.TRAIN_LEVELS} if multi_task else {})
     # Device-level tracing (SURVEY §5.1): --profile_dir captures a
     # jax.profiler trace of updates [profile_start_update,
     # +profile_num_updates) viewable in TensorBoard/XProf — the tool for
@@ -415,6 +475,38 @@ def train(config: Config) -> Dict[str, float]:
                     host_metrics["episode_frames"] = float(
                         np.mean([l for _, l in stats])
                         * config.num_action_repeats)
+                # Per-level attribution (reference logs
+                # <level>/episode_return and /episode_frames per episode,
+                # experiment.py:634-650; interval means here).
+                for level, entries in pool.drain_level_stats().items():
+                    host_metrics[f"{level}/episode_return"] = float(
+                        np.mean([r for r, _ in entries]))
+                    host_metrics[f"{level}/episode_frames"] = float(
+                        np.mean([l for _, l in entries])
+                        * config.num_action_repeats)
+                    if multi_task:
+                        bare = (level[len("dmlab_"):]
+                                if level.startswith("dmlab_") else level)
+                        if bare in suite_returns:
+                            suite_returns[bare].extend(
+                                r for r, _ in entries)
+                if multi_task and suite_returns and min(
+                        len(v) for v in suite_returns.values()) >= 1:
+                    # Every level reported since the last score: emit the
+                    # capped/uncapped human-normalized TRAINING score and
+                    # clear (reference: experiment.py:652-667).
+                    host_metrics["dmlab30/training_no_cap"] = (
+                        dmlab30.compute_human_normalized_score(
+                            suite_returns, per_level_cap=None))
+                    host_metrics["dmlab30/training_cap_100"] = (
+                        dmlab30.compute_human_normalized_score(
+                            suite_returns, per_level_cap=100.0))
+                    log.info(
+                        "dmlab30 training score — no cap: %.2f cap 100: "
+                        "%.2f", host_metrics["dmlab30/training_no_cap"],
+                        host_metrics["dmlab30/training_cap_100"])
+                    suite_returns = {
+                        name: [] for name in dmlab30.TRAIN_LEVELS}
                 if writer is not None:
                     writer.write(updates, host_metrics)
                 log.info(

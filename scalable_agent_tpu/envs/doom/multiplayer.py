@@ -518,7 +518,11 @@ def make_doom_multiplayer_env(
             respawn_delay=spec.respawn_delay, port=port,
         )
         if player_id >= 0:  # probe envs (player_id=-1) skip seeding
-            base.seed((seed or 0) * 100 + player_id * 10 + 1)
+            # seed=0 is a valid explicit seed (only None means unset),
+            # and the per-player field is wide enough (1000) that no
+            # realistic num_agents can alias the match-seed digits.
+            match_seed = 0 if seed is None else seed
+            base.seed(match_seed * 1000 + player_id + 1)
         return assemble_doom_env(
             spec, width=width, height=height, env=base, num_bots=bots,
             **kwargs)

@@ -219,8 +219,16 @@ class MultiEnv:
         ctx: Optional[str] = None,
         max_respawns: int = 16,
         respawn_window_s: float = 600.0,
+        env_labels: Optional[Sequence[str]] = None,
     ):
         self.num_envs = len(make_stream_fns)
+        # Per-env level labels for multi-task training (reference spreads
+        # actors over all 30 DMLab levels, experiment.py:552-555; per-level
+        # episode attribution feeds the training suite score, :634-667).
+        if env_labels is not None and len(env_labels) != self.num_envs:
+            raise ValueError(
+                f"{len(env_labels)} env_labels for {self.num_envs} envs")
+        self.env_labels = list(env_labels) if env_labels else None
         num_workers = min(num_workers or self.num_envs, self.num_envs)
         # spawn, not fork: see EnvProcess — the parent runs JAX.
         self._ctx = mp.get_context(ctx or "spawn")
@@ -282,6 +290,10 @@ class MultiEnv:
         # Ring buffer of (episode_return, episode_length) for finished
         # episodes (reference: multi_env.py:298-386).
         self.episode_stats = deque(maxlen=stats_episodes)
+        # Drain queue of (label, return, length), fed only when env_labels
+        # is set; consumers pop (ActorPool.drain_level_stats) so every
+        # completed episode is attributed exactly once.
+        self.level_episode_stats = deque(maxlen=max(1000, stats_episodes))
         self._pending = False
 
     def _spawn_worker(self, w: int) -> None:
@@ -381,6 +393,10 @@ class MultiEnv:
             if steps[i] > 0:  # skip initial() pseudo-done
                 self.episode_stats.append(
                     (float(returns[i]), int(steps[i])))
+                if self.env_labels is not None:
+                    self.level_episode_stats.append(
+                        (self.env_labels[i], float(returns[i]),
+                         int(steps[i])))
         return StepOutput(
             reward=rewards,
             info=StepOutputInfo(episode_return=returns, episode_step=steps),

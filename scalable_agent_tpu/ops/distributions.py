@@ -148,6 +148,34 @@ def kl_divergence(p_logits, q_logits, spec: DistributionSpec):
     return total
 
 
+def symmetric_kl(p_logits, q_logits, spec: DistributionSpec):
+    """0.5 * (KL(p || q) + KL(q || p)), summed over components.
+
+    The reference's ``kl_divergence`` is in fact this symmetric form
+    (reference: CategoricalActionDistribution._kl_symmetric/_kl_inverse
+    :84-93 and kl_divergence :100-101; TupleActionDistribution sums over
+    the tuple :193-201).
+    """
+    return 0.5 * (kl_divergence(p_logits, q_logits, spec)
+                  + kl_divergence(q_logits, p_logits, spec))
+
+
+def kl_to_prior(logits, spec: DistributionSpec):
+    """Symmetric KL against the uniform prior, summed over components.
+
+    (reference: CategoricalActionDistribution.kl_prior :95-98 — the
+    prior is uniform over each component's actions, log_prior_probs
+    :60-63; TupleActionDistribution.kl_prior :187-191.)
+    """
+    total = None
+    for chunk in _component_logits(logits, spec):
+        prior = jnp.zeros_like(chunk)  # uniform after log_softmax
+        component_spec = DistributionSpec(sizes=(chunk.shape[-1],))
+        kl = symmetric_kl(chunk, prior, component_spec)
+        total = kl if total is None else total + kl
+    return total
+
+
 def one_hot_actions(actions, spec: DistributionSpec):
     """Concatenated per-component one-hots [..., num_logits] — the
     "last action" conditioning input for composite spaces (generalizes

@@ -415,3 +415,25 @@ class ActorPool:
         for actor in self._actors:
             stats.extend(actor._envs.episode_stats)
         return stats
+
+    def drain_level_stats(self):
+        """Pop all level-attributed episodes completed since the last
+        drain: {level_name: [(episode_return, episode_length), ...]}.
+
+        Feeds multi-task per-level metrics and the DMLab-30 training
+        suite score (reference: experiment.py:634-667, which clears the
+        per-level lists after each score — draining gives the same
+        each-episode-counted-once semantics).  popleft is atomic, so
+        actor threads can keep appending during the drain."""
+        by_level = {}
+        for actor in self._actors:
+            queue = getattr(actor._envs, "level_episode_stats", None)
+            if not queue:
+                continue
+            while True:
+                try:
+                    level, ret, length = queue.popleft()
+                except IndexError:
+                    break
+                by_level.setdefault(level, []).append((ret, length))
+        return by_level

@@ -81,7 +81,7 @@ class InGraphTrainer:
         from scalable_agent_tpu.parallel.mesh import batch_sharding
 
         self._batch_sharding = batch_sharding(
-            learner._mesh, batch_axis_index=0)
+            learner.mesh, batch_axis_index=0)
         self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
 
     # -- initialization ----------------------------------------------------

@@ -145,6 +145,15 @@ class Learner:
             scan_impl = ("pallas" if fused_kernels_profitable(mesh)
                          else "associative")
         self._scan_impl = scan_impl
+        if hp.rmsprop_momentum:
+            import warnings
+
+            warnings.warn(
+                "rmsprop_momentum != 0: the momentum trace accumulates "
+                "un-lr-scaled steps (TF accumulates lr-scaled steps), so "
+                "updates diverge from the reference while the decayed lr "
+                "changes between steps (see _make_optimizer note)",
+                stacklevel=2)
         self._tx = _make_optimizer(hp)
 
         replicated = replicated_sharding(mesh)
@@ -166,6 +175,11 @@ class Learner:
         self._update = jax.jit(self._update_impl, donate_argnums=(0,))
         self._replicated = replicated
         self._traj_shardings = traj_shardings
+
+    @property
+    def mesh(self):
+        """The device mesh this learner's update is sharded over."""
+        return self._mesh
 
     # -- state ------------------------------------------------------------
 

@@ -83,6 +83,41 @@ class TestDistributionMath:
         q = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
         assert np.all(np.asarray(D.kl_divergence(p, q, SPEC)) > 0)
 
+    def test_symmetric_kl(self):
+        """Symmetric KL: zero at p == q, symmetric in its arguments,
+        and the mean of the two directed KLs (reference:
+        action_distributions.py:84-108)."""
+        rng = np.random.default_rng(4)
+        p = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+        q = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+        np.testing.assert_allclose(
+            D.symmetric_kl(p, p, SPEC), 0.0, atol=1e-6)
+        np.testing.assert_allclose(
+            D.symmetric_kl(p, q, SPEC), D.symmetric_kl(q, p, SPEC),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            D.symmetric_kl(p, q, SPEC),
+            0.5 * (D.kl_divergence(p, q, SPEC)
+                   + D.kl_divergence(q, p, SPEC)),
+            rtol=1e-6)
+
+    def test_kl_to_prior(self):
+        """Uniform policy has zero KL to the uniform prior; any peaked
+        policy has positive KL (reference: kl_prior,
+        action_distributions.py:95-98,187-191)."""
+        np.testing.assert_allclose(
+            D.kl_to_prior(jnp.zeros((2, 8)), SPEC), 0.0, atol=1e-6)
+        peaked = jnp.zeros((1, 8)).at[0, 0].set(10.0)
+        assert float(D.kl_to_prior(peaked, SPEC)[0]) > 0
+        # Decomposes as the sum over independent components.
+        rng = np.random.default_rng(5)
+        logits = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+        per_component = (
+            D.kl_to_prior(logits[:, :3], D.spec_for_space(Discrete(3)))
+            + D.kl_to_prior(logits[:, 3:], D.spec_for_space(Discrete(5))))
+        np.testing.assert_allclose(
+            D.kl_to_prior(logits, SPEC), per_component, rtol=1e-6)
+
     def test_one_hot_actions_layout(self):
         actions = jnp.asarray([[1, 4]], jnp.int32)
         one_hot = D.one_hot_actions(actions, SPEC)
