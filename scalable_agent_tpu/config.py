@@ -65,6 +65,10 @@ class Config:
     # group is one learner batch; >= 2 groups overlap env-sim with TPU
     # inference.  See driver.make_env_groups.)
     mesh_data: int = 0  # 0 = all devices
+    # Sequence/context parallelism (SURVEY §5.7): batches shard over
+    # (data x seq); the V-trace recurrence's time dimension shards over
+    # seq (parallel/sequence.py, scan_impl="time_sharded").
+    mesh_seq: int = 1
     mesh_model: int = 1
     # Multi-host (DCN) distribution — empty/0/-1 = single process.
     # (role of the reference's ClusterSpec + --job_name/--task flags,
@@ -76,8 +80,15 @@ class Config:
     # "service" (C++ dynamic batcher co-batches groups into one call —
     # the reference's architecture, dynamic_batching.py + batcher.cc).
     inference_mode: str = "structural"
-    # vtrace: auto | associative | sequential | pallas — auto picks the
-    # fused Pallas kernel on a single-device TPU mesh, associative else.
+    # Training backend: "host" (actor pool + prefetch + learner — the
+    # reference's architecture, experiment.py:479-672) or "ingraph"
+    # (rollout + update fused into ONE jitted device program for
+    # device-expressible levels, runtime/ingraph.py — zero per-step
+    # host↔device traffic).
+    train_backend: str = "host"
+    # vtrace: auto | associative | sequential | pallas | time_sharded —
+    # auto picks time_sharded when mesh_seq > 1, the fused Pallas kernel
+    # on a single-device TPU mesh, associative else.
     scan_impl: str = "auto"
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5

@@ -72,24 +72,26 @@ def resolve_mesh_data(config: Config) -> int:
     mesh construction and every "auto" kernel-choice estimate so they
     can never disagree."""
     n_devices = len(jax.devices())
+    non_data = config.mesh_seq * config.mesh_model
     if jax.process_count() > 1:
         # Multi-host meshes must span EVERY process's devices: a
         # truncated device list would exclude whole processes, whose
         # local batch shards then have no addressable home in
         # make_array_from_process_local_data.
-        mesh_data = config.mesh_data or n_devices // config.mesh_model
-        if mesh_data * config.mesh_model != n_devices:
+        mesh_data = config.mesh_data or n_devices // non_data
+        if mesh_data * non_data != n_devices:
             raise ValueError(
                 f"multi-host mesh (data={mesh_data}, "
-                f"model={config.mesh_model}) must cover all "
-                f"{n_devices} global devices")
+                f"seq={config.mesh_seq}, model={config.mesh_model}) "
+                f"must cover all {n_devices} global devices")
         return mesh_data
-    # The batch axis shards over 'data': pick the largest data-axis
-    # size that divides the batch (a 4-batch debug run on an
-    # 8-device mesh uses 4 of them rather than failing), out of the
-    # devices left after the model axis takes its share.
+    # The batch axis shards over ('data', 'seq'): pick the largest
+    # data-axis size such that data*seq divides the batch (a 4-batch
+    # debug run on an 8-device mesh uses 4 of them rather than
+    # failing), out of the devices left after seq/model take theirs.
     return config.mesh_data or math.gcd(
-        config.batch_size, max(1, n_devices // config.mesh_model))
+        max(1, config.batch_size // config.mesh_seq),
+        max(1, n_devices // non_data))
 
 
 def resolve_core_impl(config: Config) -> str:
@@ -98,7 +100,8 @@ def resolve_core_impl(config: Config) -> str:
     train() will build (the agent is built before the mesh exists)."""
     if config.core_impl != "auto":
         return config.core_impl
-    num = resolve_mesh_data(config) * config.mesh_model
+    num = (resolve_mesh_data(config) * config.mesh_seq
+           * config.mesh_model)
     from scalable_agent_tpu.parallel.mesh import fused_kernels_profitable
     return "pallas" if fused_kernels_profitable(num_devices=num) else "xla"
 
@@ -348,6 +351,13 @@ def train(config: Config) -> Dict[str, float]:
         is_coordinator,
     )
 
+    if config.train_backend == "ingraph":
+        return train_ingraph(config)
+    if config.train_backend != "host":
+        raise ValueError(
+            f"unknown train_backend {config.train_backend!r} "
+            f"(host | ingraph)")
+
     initialize_distributed(
         config.distributed_coordinator or None,
         config.distributed_num_processes or None,
@@ -364,27 +374,7 @@ def train(config: Config) -> Dict[str, float]:
     observation_spec, action_space, num_agents = probe_env(probe_config)
     agent = build_agent(config, action_space)
 
-    mesh_data = resolve_mesh_data(config)
-    if config.batch_size % mesh_data:
-        raise ValueError(
-            f"batch_size {config.batch_size} not divisible by data-axis "
-            f"size {mesh_data}")
-    devices = jax.devices()[:mesh_data * config.mesh_model]
-    mesh = make_mesh(MeshSpec(data=mesh_data, model=config.mesh_model),
-                     devices=devices)
-    hp = LearnerHyperparams(
-        entropy_cost=config.entropy_cost,
-        baseline_cost=config.baseline_cost,
-        discounting=config.discounting,
-        reward_clipping=config.reward_clipping,
-        learning_rate=config.learning_rate,
-        total_environment_frames=config.total_environment_frames,
-        rmsprop_decay=config.rmsprop_decay,
-        rmsprop_momentum=config.rmsprop_momentum,
-        rmsprop_epsilon=config.rmsprop_epsilon,
-    )
-    learner = Learner(agent, hp, mesh, config.frames_per_update(),
-                      scan_impl=config.scan_impl)
+    _, learner = build_training_learner(config, agent)
 
     ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
                              config.checkpoint_keep)
@@ -538,6 +528,140 @@ def train(config: Config) -> Dict[str, float]:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("train_exit")
+    return {k: _host_scalar(v) for k, v in metrics.items()}
+
+
+def build_training_learner(config: Config, agent: ImpalaAgent):
+    """Validation + mesh + Learner construction shared by BOTH train
+    backends (host and ingraph), so their hyperparameters and checks can
+    never drift."""
+    mesh_data = resolve_mesh_data(config)
+    if config.batch_size % (mesh_data * config.mesh_seq):
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by the "
+            f"batch-sharding axes data*seq = "
+            f"{mesh_data * config.mesh_seq}")
+    if config.mesh_seq > 1 and config.unroll_length % config.mesh_seq:
+        raise ValueError(
+            f"unroll_length {config.unroll_length} not divisible by "
+            f"seq-axis size {config.mesh_seq} (time-sharded V-trace "
+            f"chunks the unroll evenly)")
+    devices = jax.devices()[:mesh_data * config.mesh_seq
+                            * config.mesh_model]
+    mesh = make_mesh(MeshSpec(data=mesh_data, seq=config.mesh_seq,
+                              model=config.mesh_model),
+                     devices=devices)
+    hp = LearnerHyperparams(
+        entropy_cost=config.entropy_cost,
+        baseline_cost=config.baseline_cost,
+        discounting=config.discounting,
+        reward_clipping=config.reward_clipping,
+        learning_rate=config.learning_rate,
+        total_environment_frames=config.total_environment_frames,
+        rmsprop_decay=config.rmsprop_decay,
+        rmsprop_momentum=config.rmsprop_momentum,
+        rmsprop_epsilon=config.rmsprop_epsilon,
+    )
+    learner = Learner(agent, hp, mesh, config.frames_per_update(),
+                      scan_impl=config.scan_impl)
+    return mesh, learner
+
+
+def train_ingraph(config: Config) -> Dict[str, float]:
+    """Fused in-graph training: rollout + update as ONE jitted device
+    program per update (runtime/ingraph.py), for levels whose simulator
+    is expressible in XLA (envs/device.py).
+
+    Checkpoint cadence, metrics names, LR schedule, and resume semantics
+    match the host loop exactly — the two backends share the Learner and
+    CheckpointManager — so `--train_backend=ingraph` is a drop-in flag.
+    (Replaces the whole host actor pipeline the reference is built
+    around, experiment.py:479-672, with zero per-step host↔device
+    traffic.)
+    """
+    from scalable_agent_tpu.envs.device import make_device_env
+    from scalable_agent_tpu.runtime import InGraphTrainer
+
+    # This dispatch runs BEFORE jax.distributed would initialize, so
+    # check the config flags too — process_count() alone is still 1
+    # here even when the user asked for a distributed run, and silently
+    # training P independent duplicate runs into one logdir would be
+    # far worse than this error.
+    if (jax.process_count() > 1 or config.distributed_coordinator
+            or config.distributed_num_processes > 0):
+        raise ValueError(
+            "train_backend=ingraph is single-process (the host backend "
+            "covers multi-host training)")
+    config = apply_env_overrides(config)
+    config.save()
+
+    # Probe the HOST twin of the level so action/observation specs stay
+    # in lock-step with the device mirror (they are asserted
+    # interchangeable in tests/test_device_env.py).
+    observation_spec, action_space, _ = probe_env(config)
+    agent = build_agent(config, action_space)
+    env = make_device_env(
+        config.level_name, height=config.height, width=config.width,
+        # Composite spaces have no .n; make_device_env rejects their
+        # levels with a clear error before num_actions matters.
+        num_actions=getattr(action_space, "n", 0),
+        num_action_repeats=config.num_action_repeats,
+        with_instruction=config.use_instruction)
+
+    _, learner = build_training_learner(config, agent)
+    trainer = InGraphTrainer(agent, learner, env, config.unroll_length,
+                             config.batch_size, seed=config.seed)
+    state, carry = trainer.init(jax.random.key(config.seed))
+
+    ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
+                             config.checkpoint_keep)
+    restored = ckpt.restore(target=state)
+    if restored is not None:
+        start_updates, host_state = restored
+        state = learner.place_state(host_state)
+        log.info("restored checkpoint at update %d (%.0f frames); the "
+                 "device env rollout restarts from fresh episodes (like "
+                 "the host pipeline's env processes)",
+                 start_updates, _host_scalar(state.env_frames))
+    else:
+        start_updates = 0
+
+    writer = MetricsWriter(config.logdir)
+    timing = Timing()
+    updates = start_updates
+    frames_per_update = config.frames_per_update()
+    frames = _host_scalar(state.env_frames)
+    last_log = time.monotonic()
+    frames_at_last_log = frames
+    metrics = {}
+    try:
+        while frames < config.total_environment_frames:
+            with timing.time_avg("update"):
+                # The update counter keys the rollout rng
+                # (jax.random.fold_in), so resume continues the exact
+                # action-sampling stream the interrupted run would have
+                # used.
+                state, carry, metrics = trainer.train_step(
+                    state, carry, np.int32(updates))
+            updates += 1
+            frames += frames_per_update
+            now = time.monotonic()
+            if now - last_log >= config.log_interval_s:
+                host_metrics = {k: _host_scalar(v)
+                                for k, v in metrics.items()}
+                fps = (frames - frames_at_last_log) / (now - last_log)
+                host_metrics["fps"] = fps
+                writer.write(updates, host_metrics)
+                log.info(
+                    "update %d frames %.3g fps %.0f loss %.3f | %s",
+                    updates, frames, fps,
+                    host_metrics.get("total_loss", float("nan")), timing)
+                last_log, frames_at_last_log = now, frames
+            ckpt.maybe_save(updates, state)
+        ckpt.maybe_save(updates, state, force=True)
+    finally:
+        writer.close()
+        ckpt.close()
     return {k: _host_scalar(v) for k, v in metrics.items()}
 
 

@@ -201,3 +201,39 @@ class DeviceFakeEnv:
                 instruction=None),
         )
         return new_state, output
+
+
+def make_device_env(level_name: str, height: int = 0, width: int = 0,
+                    num_actions: int = 9, num_action_repeats: int = 1,
+                    with_instruction: bool = False,
+                    **kwargs) -> DeviceFakeEnv:
+    """Device-env factory for levels expressible as pure XLA functions
+    (the in-graph training backend, runtime/ingraph.py + driver
+    --train_backend=ingraph).
+
+    Mirrors the host fake-family defaults (envs/registry.py _make_fake)
+    so probe_env's host spec matches the device env exactly.  Levels
+    whose simulators live in external processes (doom_/dmlab_/atari_)
+    cannot run in-graph; asking for one is a clear error, not a silent
+    fallback.
+    """
+    if with_instruction:
+        raise ValueError(
+            "device envs do not emit instruction observations")
+    defaults = {
+        "fake_benchmark": dict(height=72, width=96, episode_length=1000),
+        "fake_small": dict(height=16, width=16, episode_length=10),
+    }
+    if level_name not in defaults:
+        raise ValueError(
+            f"level {level_name!r} has no device (in-graph) "
+            f"implementation; device-expressible levels: "
+            f"{sorted(defaults)}")
+    params = dict(defaults[level_name])
+    if height:
+        params["height"] = height
+    if width:
+        params["width"] = width
+    params.update(kwargs)
+    return DeviceFakeEnv(num_actions=num_actions,
+                         num_action_repeats=num_action_repeats, **params)

@@ -133,12 +133,30 @@ def from_importance_weights(
     clip_rho_threshold: Optional[float] = 1.0,
     clip_pg_rho_threshold: Optional[float] = 1.0,
     scan_impl: str = "associative",
+    mesh=None,
+    seq_axis: str = "seq",
 ) -> VTraceReturns:
     """V-trace targets from log importance weights.
 
     Shapes: log_rhos/discounts/rewards/values [T, B, C...],
     bootstrap_value [B, C...].  (reference: vtrace.py:164-280)
+
+    ``scan_impl="time_sharded"``: the recurrence's time dimension shards
+    over ``mesh[seq_axis]`` (sequence/context parallelism,
+    parallel/sequence.py) — the distributed replacement for the
+    reference's CPU-pinned sequential scan (vtrace.py:250-262).
     """
+    if scan_impl == "time_sharded":
+        if mesh is None:
+            raise ValueError(
+                "scan_impl='time_sharded' needs the mesh argument")
+        from scalable_agent_tpu.parallel import sequence
+
+        return sequence.from_importance_weights_sharded(
+            mesh, log_rhos, discounts, rewards, values, bootstrap_value,
+            clip_rho_threshold=clip_rho_threshold,
+            clip_pg_rho_threshold=clip_pg_rho_threshold,
+            seq_axis=seq_axis)
     log_rhos = jnp.asarray(log_rhos, jnp.float32)
     discounts = jnp.asarray(discounts, jnp.float32)
     rewards = jnp.asarray(rewards, jnp.float32)
@@ -205,6 +223,8 @@ def from_logits(
     clip_pg_rho_threshold: Optional[float] = 1.0,
     scan_impl: str = "associative",
     dist_spec=None,
+    mesh=None,
+    seq_axis: str = "seq",
 ) -> VTraceFromLogitsReturns:
     """V-trace for softmax policies.  (reference: vtrace.py:71-161)
 
@@ -250,7 +270,9 @@ def from_logits(
         bootstrap_value=bootstrap_value,
         clip_rho_threshold=clip_rho_threshold,
         clip_pg_rho_threshold=clip_pg_rho_threshold,
-        scan_impl=scan_impl)
+        scan_impl=scan_impl,
+        mesh=mesh,
+        seq_axis=seq_axis)
 
     return VTraceFromLogitsReturns(
         vs=vtrace_returns.vs,

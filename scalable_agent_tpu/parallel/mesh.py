@@ -25,36 +25,47 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 class MeshSpec(NamedTuple):
-    """Logical mesh shape: data x model."""
+    """Logical mesh shape: data x seq x model.
+
+    ``seq`` is the sequence/context-parallel axis (SURVEY §5.7): batches
+    shard over (data x seq) for the model compute, and the V-trace
+    recurrence's TIME dimension shards over ``seq``
+    (parallel/sequence.py) when the Learner runs
+    ``scan_impl="time_sharded"``.  Degenerate (=1) everywhere else."""
 
     data: int
+    seq: int = 1
     model: int = 1
 
 
 def make_mesh(spec: Optional[MeshSpec] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a 2-axis ('data', 'model') mesh over ``devices``.
+    """Build a 3-axis ('data', 'seq', 'model') mesh over ``devices``.
 
-    Defaults: all devices on the data axis, model=1.
+    Defaults: all devices on the data axis, seq=model=1.
     """
     devices = list(devices if devices is not None else jax.devices())
     if spec is None:
-        spec = MeshSpec(data=len(devices), model=1)
-    if spec.data * spec.model != len(devices):
+        spec = MeshSpec(data=len(devices))
+    if spec.data * spec.seq * spec.model != len(devices):
         raise ValueError(
-            f"mesh {spec} needs {spec.data * spec.model} devices, "
-            f"got {len(devices)}")
-    array = np.asarray(devices).reshape(spec.data, spec.model)
-    return Mesh(array, axis_names=("data", "model"))
+            f"mesh {spec} needs {spec.data * spec.seq * spec.model} "
+            f"devices, got {len(devices)}")
+    array = np.asarray(devices).reshape(spec.data, spec.seq, spec.model)
+    return Mesh(array, axis_names=("data", "seq", "model"))
 
 
 def batch_sharding(mesh: Mesh, batch_axis_index: int = 1) -> NamedSharding:
-    """Shard the batch dimension over the data axis.
+    """Shard the batch dimension over the (data, seq) axes.
 
     Trajectories are time-major [T, B, ...]; B is ``batch_axis_index`` 1.
+    The seq axis joins the batch sharding so its devices carry real
+    model compute too — time-resharding happens only around the V-trace
+    recurrence (parallel/sequence.py).
     """
     pspec = [None] * (batch_axis_index + 1)
-    pspec[batch_axis_index] = "data"
+    pspec[batch_axis_index] = (("data", "seq")
+                               if "seq" in mesh.shape else "data")
     return NamedSharding(mesh, PartitionSpec(*pspec))
 
 

@@ -112,7 +112,19 @@ def from_importance_weights_sharded(
         clip_rho_threshold)
 
     ndim = log_rhos.ndim
-    time_sharded = PartitionSpec(seq_axis, *([None] * (ndim - 1)))
+    # Keep the batch dimension sharded over 'data' while time shards
+    # over the seq axis: on a dp x sp mesh the inputs then move WITHOUT
+    # any batch all-gather (each device holds its [T/S, B/D] tile and
+    # computes only its shard's recurrence).  When the caller uses the
+    # data axis itself as the time axis (standalone/demo usage), the
+    # batch stays unsharded — an axis can appear only once in a spec.
+    batch_axis = ("data" if ndim >= 2 and seq_axis != "data"
+                  and "data" in mesh.axis_names else None)
+    trailing = [None] * max(0, ndim - 2)
+    if ndim >= 2:
+        time_sharded = PartitionSpec(seq_axis, batch_axis, *trailing)
+    else:
+        time_sharded = PartitionSpec(seq_axis)
     fn = shard_map(
         functools.partial(_chunk_recurrence, axis_name=seq_axis),
         mesh=mesh,

@@ -141,9 +141,17 @@ class Learner:
             # The fused Pallas V-trace (ops/vtrace_pallas.py) measures
             # 1.23x faster per learner update on a single v5e chip;
             # the shared policy predicate decides where it wins.
-            # Explicit "pallas" forces it anywhere.
-            scan_impl = ("pallas" if fused_kernels_profitable(mesh)
-                         else "associative")
+            # Explicit "pallas" forces it anywhere.  A seq axis > 1
+            # auto-selects the time-sharded recurrence
+            # (parallel/sequence.py — SURVEY §5.7 sequence parallelism).
+            if mesh.shape.get("seq", 1) > 1:
+                scan_impl = "time_sharded"
+            else:
+                scan_impl = ("pallas" if fused_kernels_profitable(mesh)
+                             else "associative")
+        if scan_impl == "time_sharded" and mesh.shape.get("seq", 1) == 1:
+            # Degenerate seq axis: the shard_map would be pure overhead.
+            scan_impl = "associative"
         self._scan_impl = scan_impl
         if hp.rmsprop_momentum:
             import warnings
@@ -285,6 +293,7 @@ class Learner:
             clip_pg_rho_threshold=hp.clip_pg_rho_threshold,
             scan_impl=self._scan_impl,
             dist_spec=dist_spec,
+            mesh=self._mesh if self._scan_impl == "time_sharded" else None,
         )
 
         pg_loss = losses_lib.compute_policy_gradient_loss(

@@ -31,3 +31,30 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- smoke tier ------------------------------------------------------------
+# `pytest -m smoke` is the time-boxed CI selection (< 2 min on one core):
+# the pure-math and protocol modules below, minus anything marked slow.
+# Heavier end-to-end coverage stays in the default/-m slow tiers.
+
+import pytest  # noqa: E402
+
+_SMOKE_MODULES = {
+    "test_vtrace",
+    "test_losses",
+    "test_distributions",
+    "test_utils_algo",
+    "test_utils_misc",
+    "test_batcher",
+    "test_sequence_parallel",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = getattr(item, "module", None)
+        if (module is not None
+                and module.__name__ in _SMOKE_MODULES
+                and "slow" not in item.keywords):
+            item.add_marker(pytest.mark.smoke)

@@ -160,6 +160,48 @@ class TestCoreImplCheckpointInterop:
 
 
 @pytest.mark.slow
+class TestInGraphBackend:
+    """--train_backend=ingraph: the fused rollout+update program as a
+    CLI-reachable training mode with checkpoint/metrics/resume parity
+    (VERDICT r3 item 5; replaces the reference's host actor pipeline,
+    experiment.py:479-672, for device-expressible levels)."""
+
+    def test_ingraph_trains_checkpoints_resumes(self, tmp_path):
+        config = small_config(
+            tmp_path, train_backend="ingraph", level_name="fake_benchmark",
+            num_actors=4, batch_size=4, unroll_length=5,
+            num_action_repeats=4,
+            total_environment_frames=240)  # 3 updates of 80 frames
+        metrics = run_train(config)
+        assert metrics["env_frames"] == 240
+        assert np.isfinite(metrics["total_loss"])
+        rows = [json.loads(line) for line in
+                open(os.path.join(config.logdir, "metrics.jsonl"))]
+        assert any("total_loss" in r for r in rows)
+        assert any("learning_rate" in r for r in rows)
+        assert glob.glob(os.path.join(config.logdir, "checkpoints", "*"))
+
+        # Resume continues the frame count (and LR schedule) exactly.
+        config2 = small_config(
+            tmp_path, train_backend="ingraph", level_name="fake_benchmark",
+            num_actors=4, batch_size=4, unroll_length=5,
+            num_action_repeats=4, total_environment_frames=320)
+        metrics2 = run_train(config2)
+        assert metrics2["env_frames"] == 320
+        rows_after = sum(
+            1 for line in open(os.path.join(config.logdir, "metrics.jsonl"))
+            if "total_loss" in line)
+        # One more 80-frame update, not a from-scratch retrain.
+        assert rows_after - len(rows) == 1
+
+    def test_ingraph_rejects_host_only_levels(self, tmp_path):
+        config = small_config(tmp_path, train_backend="ingraph",
+                              level_name="fake_tuple")
+        with pytest.raises(ValueError, match="in-graph"):
+            run_train(config)
+
+
+@pytest.mark.slow
 class TestMultiTaskTraining:
     """--mode=train --level_name=dmlab30 spreads env slots over all 30
     train levels with per-level metrics and a training suite score

@@ -69,3 +69,86 @@ def test_uneven_split_raises():
     inputs = make_inputs(10, 2)  # 10 % 4 != 0
     with pytest.raises(ValueError, match="divide evenly"):
         from_importance_weights_sharded(mesh, seq_axis="data", **inputs)
+
+
+def test_from_importance_weights_dispatches_time_sharded():
+    """ops/vtrace.from_importance_weights(scan_impl="time_sharded") is
+    the config-reachable entry to the sharded recurrence."""
+    mesh = make_mesh(MeshSpec(data=1, seq=4, model=1),
+                     devices=jax.devices()[:4])
+    inputs = make_inputs(32, 3, seed=2)
+    ref = vtrace.from_importance_weights(scan_impl="associative", **inputs)
+    out = vtrace.from_importance_weights(
+        scan_impl="time_sharded", mesh=mesh, **inputs)
+    np.testing.assert_allclose(
+        np.asarray(out.vs), np.asarray(ref.vs), rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="mesh"):
+        vtrace.from_importance_weights(scan_impl="time_sharded", **inputs)
+
+
+@pytest.mark.slow
+class TestLearnerTimeSharded:
+    """Full Learner.update on a (data=2, seq=2) mesh == the
+    single-axis associative path (the SURVEY §5.7 hook, reachable from
+    config via mesh_seq/scan_impl — VERDICT r3 item 4)."""
+
+    def test_update_parity(self):
+        import functools
+
+        from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+        from scalable_agent_tpu.envs.spec import TensorSpec
+        from scalable_agent_tpu.models import ImpalaAgent
+        from scalable_agent_tpu.runtime import (
+            Learner, LearnerHyperparams, Trajectory, VectorActor)
+
+        T, B = 8, 8
+        frame = TensorSpec((16, 16, 3), np.uint8, "frame")
+        agent = ImpalaAgent(num_actions=4)
+        fns = [functools.partial(make_impala_stream, "fake_small",
+                                 seed=i, num_actions=4)
+               for i in range(B)]
+        envs = MultiEnv(fns, frame, num_workers=2)
+        try:
+            mesh_flat = make_mesh(MeshSpec(data=4),
+                                  devices=jax.devices()[:4])
+            mesh_seq = make_mesh(MeshSpec(data=2, seq=2),
+                                 devices=jax.devices()[:4])
+            hp = LearnerHyperparams(total_environment_frames=1e6)
+            ref = Learner(agent, hp, mesh_flat, frames_per_update=T * B,
+                          scan_impl="associative")
+            sharded = Learner(agent, hp, mesh_seq, frames_per_update=T * B)
+            assert sharded._scan_impl == "time_sharded"  # auto-selected
+
+            actor = VectorActor(agent, envs, T, seed=3)
+            actor._bootstrap(None)
+            params = agent.init(
+                jax.random.key(0),
+                np.asarray(agent.zero_actions(B))[None],
+                jax.tree_util.tree_map(
+                    lambda x: None if x is None else np.asarray(x)[None],
+                    actor._last_env_output, is_leaf=lambda x: x is None),
+                actor._core_state)
+            out = actor.run_unroll(params)
+            traj = Trajectory(out.agent_state, out.env_outputs,
+                              out.agent_outputs)
+
+            state_ref = ref.init(jax.random.key(1), traj)
+            state_sh = sharded.init(jax.random.key(1), traj)
+            state_ref, metrics_ref = ref.update(
+                state_ref, ref.put_trajectory(traj))
+            state_sh, metrics_sh = sharded.update(
+                state_sh, sharded.put_trajectory(traj))
+
+            for key in ("total_loss", "policy_gradient_loss",
+                        "baseline_loss", "entropy_loss", "grad_norm"):
+                np.testing.assert_allclose(
+                    float(metrics_ref[key]), float(metrics_sh[key]),
+                    rtol=2e-4, err_msg=key)
+            # Updated params agree leaf-by-leaf.
+            flat_ref = jax.tree_util.tree_leaves(state_ref.params)
+            flat_sh = jax.tree_util.tree_leaves(state_sh.params)
+            for a, b in zip(flat_ref, flat_sh):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+        finally:
+            envs.close()
