@@ -76,9 +76,14 @@ class Config:
     distributed_coordinator: str = ""  # e.g. "10.0.0.1:8476"
     distributed_num_processes: int = 0
     distributed_process_id: int = -1
-    # Actor inference: "structural" (one jitted step per group) or
+    # Actor inference: "structural" (one jitted step per group),
     # "service" (C++ dynamic batcher co-batches groups into one call —
-    # the reference's architecture, dynamic_batching.py + batcher.cc).
+    # the reference's architecture, dynamic_batching.py + batcher.cc),
+    # "accum" (on-device trajectory accumulation: per step only frame
+    # bytes go up and actions come down, runtime/accum_actor.py), or
+    # "accum_fused" (accum + cross-group lockstep co-dispatch: ONE
+    # device call and ONE action fetch serve all groups per step —
+    # ~1 link RTT regardless of group count).
     inference_mode: str = "structural"
     # Training backend: "host" (actor pool + prefetch + learner — the
     # reference's architecture, experiment.py:479-672) or "ingraph"

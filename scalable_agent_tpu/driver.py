@@ -374,7 +374,7 @@ def train(config: Config) -> Dict[str, float]:
     observation_spec, action_space, num_agents = probe_env(probe_config)
     agent = build_agent(config, action_space)
 
-    _, learner = build_training_learner(config, agent)
+    learner = build_training_learner(config, agent)
 
     ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
                              config.checkpoint_keep)
@@ -394,7 +394,8 @@ def train(config: Config) -> Dict[str, float]:
                                  level_names=level_names)
     pool = ActorPool(agent, env_groups, config.unroll_length,
                      level_name=config.level_name, seed=config.seed,
-                     inference_mode=config.inference_mode)
+                     inference_mode=config.inference_mode,
+                     observation_spec=observation_spec)
     pool.set_params(state.params)
     pool.start()
 
@@ -562,9 +563,10 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
         rmsprop_momentum=config.rmsprop_momentum,
         rmsprop_epsilon=config.rmsprop_epsilon,
     )
-    learner = Learner(agent, hp, mesh, config.frames_per_update(),
-                      scan_impl=config.scan_impl)
-    return mesh, learner
+    # The mesh is reachable as learner.mesh; returning just the Learner
+    # keeps one source of truth.
+    return Learner(agent, hp, mesh, config.frames_per_update(),
+                   scan_impl=config.scan_impl)
 
 
 def train_ingraph(config: Config) -> Dict[str, float]:
@@ -607,8 +609,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         num_actions=getattr(action_space, "n", 0),
         num_action_repeats=config.num_action_repeats,
         with_instruction=config.use_instruction)
+    host_frame = tuple(observation_spec.frame.shape)
+    device_frame = tuple(env.observation_spec.frame.shape)
+    if host_frame != device_frame:
+        raise ValueError(
+            f"host/device observation drift: host frame {host_frame} "
+            f"!= device mirror {device_frame} (envs/fake.py and "
+            f"envs/device.py must stay in lock-step)")
 
-    _, learner = build_training_learner(config, agent)
+    learner = build_training_learner(config, agent)
     trainer = InGraphTrainer(agent, learner, env, config.unroll_length,
                              config.batch_size, seed=config.seed)
     state, carry = trainer.init(jax.random.key(config.seed))

@@ -68,11 +68,24 @@ class AccumPrograms:
     groups so every group hits the same executable cache."""
 
     def __init__(self, agent: ImpalaAgent, unroll_length: int,
-                 batch: int, frame_shape: Tuple[int, ...]):
+                 batch: int, frame_shape: Tuple[int, ...],
+                 instruction_shape: Optional[Tuple[int, ...]] = None,
+                 measurements_shape: Optional[Tuple[int, ...]] = None):
         self.agent = agent
         self.unroll_length = unroll_length
         self.batch = batch
         self.frame_shape = tuple(frame_shape)
+        # Optional per-env trailing shapes for instruction token ids
+        # (int32, language DMLab levels) and measurement vectors (f32,
+        # Doom's additional-input wrapper) — when set, both ride the
+        # per-step upload and get their own [T+1, B, ...] device
+        # buffers, so language/measurement levels keep the accum path's
+        # two-uploads-one-fetch link discipline.
+        self.instruction_shape = (tuple(instruction_shape)
+                                  if instruction_shape is not None else None)
+        self.measurements_shape = (
+            tuple(measurements_shape)
+            if measurements_shape is not None else None)
         t1 = unroll_length + 1
         k = agent.num_action_components
         self._action_shape = (batch,) if k == 1 else (batch, k)
@@ -83,21 +96,25 @@ class AccumPrograms:
         )
 
         self.step = jax.jit(self._step_impl, donate_argnums=(5,))
-        self.finish = jax.jit(self._finish_impl, donate_argnums=(2,))
+        self.finish = jax.jit(self._finish_impl, donate_argnums=(3,))
         self.bootstrap = jax.jit(self._bootstrap_impl)
 
     # -- buffer pytree -----------------------------------------------------
 
-    def _unpack(self, frame_flat, packed):
-        """(flat frame bytes, [4,B] f32) -> StepOutput batch."""
+    def _unpack(self, frame_flat, packed, extras):
+        """(flat frame bytes, [4,B] f32, (instr?, meas?)) -> StepOutput
+        batch.  ``extras`` members are None exactly when the matching
+        shape is unconfigured (a static property of the programs)."""
         frame = frame_flat.reshape((self.batch,) + self.frame_shape)
+        instruction, measurements = extras
         return StepOutput(
             reward=packed[0],
             info=StepOutputInfo(
                 episode_return=packed[2],
                 episode_step=packed[3].astype(jnp.int32)),
             done=packed[1] > 0.5,
-            observation=Observation(frame=frame, instruction=None),
+            observation=Observation(frame=frame, instruction=instruction,
+                                    measurements=measurements),
         )
 
     def _zero_bufs(self):
@@ -112,7 +129,14 @@ class AccumPrograms:
                 done=jnp.zeros((t1, b), bool),
                 observation=Observation(
                     frame=jnp.zeros(self._bufs_shape["frame"], jnp.uint8),
-                    instruction=None),
+                    instruction=(
+                        jnp.zeros((t1, b) + self.instruction_shape,
+                                  jnp.int32)
+                        if self.instruction_shape is not None else None),
+                    measurements=(
+                        jnp.zeros((t1, b) + self.measurements_shape,
+                                  jnp.float32)
+                        if self.measurements_shape is not None else None)),
             ),
             AgentOutput(
                 action=jnp.zeros(self._bufs_shape["action"], jnp.int32),
@@ -145,10 +169,10 @@ class AccumPrograms:
 
     # -- programs ----------------------------------------------------------
 
-    def _bootstrap_impl(self, frame_flat, packed):
+    def _bootstrap_impl(self, frame_flat, packed, extras):
         """First-ever entry: env slot 0 = initial output, agent slot 0 =
         zeros (reference: experiment.py:243-251)."""
-        env_entry = self._unpack(frame_flat, packed)
+        env_entry = self._unpack(frame_flat, packed, extras)
         agent_entry = AgentOutput(
             action=jnp.zeros(self._action_shape, jnp.int32),
             policy_logits=jnp.zeros(
@@ -158,13 +182,13 @@ class AccumPrograms:
         return self._write(self._zero_bufs(), 0, env_entry, agent_entry)
 
     def _step_impl(self, params, seed, counter, slot, frame_flat, bufs,
-                   packed, core_state):
+                   packed, extras, core_state):
         """Iteration ``slot`` (1-based): the incoming env fields are
         entry ``slot-1``; the computed agent output is entry ``slot``.
 
         The last action feeding the model is read back from agent slot
         ``slot-1`` on device — it never crosses to the host."""
-        env_entry = self._unpack(frame_flat, packed)
+        env_entry = self._unpack(frame_flat, packed, extras)
         bufs = self._write(bufs, slot - 1, env_entry=env_entry)
         last_action = jax.lax.dynamic_index_in_dim(
             bufs[1].action, slot - 1, axis=0, keepdims=False)
@@ -174,12 +198,12 @@ class AccumPrograms:
         bufs = self._write(bufs, slot, agent_entry=out)
         return out.action, new_core, bufs
 
-    def _finish_impl(self, frame_flat, packed, bufs):
+    def _finish_impl(self, frame_flat, packed, extras, bufs):
         """Seal the unroll: write env slot T (the output of the host env
         step taken AFTER the last inference), emit the trajectory, and
         seed the next unroll's buffers with the overlap entry."""
         t = self.unroll_length
-        env_entry = self._unpack(frame_flat, packed)
+        env_entry = self._unpack(frame_flat, packed, extras)
         traj = self._write(bufs, t, env_entry=env_entry)
         last_agent = jax.tree_util.tree_map(
             lambda x: None if x is None else x[t], traj[1],
@@ -188,6 +212,40 @@ class AccumPrograms:
             self._zero_bufs(), 0, env_entry=env_entry,
             agent_entry=last_agent)
         return traj, next_bufs
+
+
+def _upload_fields(programs: AccumPrograms, env_output: StepOutput):
+    """One env group's per-step host->device payload: (flat frame bytes,
+    packed [4, B] f32, (instruction?, measurements?)).  Validates that
+    the env's optional observation streams match the programs' static
+    buffer configuration with a pointed error."""
+    obs = env_output.observation
+    if (obs.instruction is not None) != (
+            programs.instruction_shape is not None):
+        raise ValueError(
+            "instruction observation/programs mismatch: the env "
+            f"{'emits' if obs.instruction is not None else 'lacks'} "
+            "instructions but AccumPrograms was built "
+            f"{'without' if programs.instruction_shape is None else 'with'} "
+            "instruction_shape (pass the observation_spec through "
+            "ActorPool)")
+    if (obs.measurements is not None) != (
+            programs.measurements_shape is not None):
+        raise ValueError(
+            "measurements observation/programs mismatch: the env "
+            f"{'emits' if obs.measurements is not None else 'lacks'} "
+            "measurements but AccumPrograms was built "
+            f"{'without' if programs.measurements_shape is None else 'with'} "
+            "measurements_shape (pass the observation_spec through "
+            "ActorPool)")
+    extras = (
+        None if obs.instruction is None
+        else np.asarray(obs.instruction, np.int32),
+        None if obs.measurements is None
+        else np.asarray(obs.measurements, np.float32),
+    )
+    frame = np.asarray(obs.frame)
+    return frame.reshape(-1), _pack_env_fields(env_output), extras
 
 
 class AccumVectorActor:
@@ -222,13 +280,7 @@ class AccumVectorActor:
         return frame.reshape(-1)  # free view; MultiEnv hands a fresh copy
 
     def _upload(self, env_output: StepOutput):
-        if (env_output.observation.instruction is not None
-                or env_output.observation.measurements is not None):
-            raise NotImplementedError(
-                "accum inference mode does not carry instructions or "
-                "measurements yet; use inference_mode='structural'")
-        return (self._flat_frame(env_output),
-                _pack_env_fields(env_output))
+        return _upload_fields(self._p, env_output)
 
     def run_unroll(self, params) -> ActorOutput:
         p = self._p
@@ -244,10 +296,11 @@ class AccumVectorActor:
         bufs = self._bufs
         for slot in range(1, p.unroll_length + 1):
             self._counter += 1
-            frame_flat, packed = self._upload(self._last_env_host)
+            frame_flat, packed, extras = self._upload(self._last_env_host)
             action_dev, core_state, bufs = p.step(
                 params, self._seed, np.int32(self._counter),
-                np.int32(slot), frame_flat, bufs, packed, core_state)
+                np.int32(slot), frame_flat, bufs, packed, extras,
+                core_state)
             actions = np.asarray(action_dev)  # the ONLY per-step fetch
             self._envs.step_send(actions)
             self._last_env_host = self._envs.step_recv()
@@ -265,3 +318,119 @@ class AccumVectorActor:
 
     def close(self):
         self._envs.close()
+
+
+def _stack_group_axis(trees):
+    """List of k pytrees -> one pytree with a leading [k] axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: None if xs[0] is None else np.stack(xs),
+        *trees, is_leaf=lambda x: x is None)
+
+
+class GroupedAccumActor:
+    """Cross-group co-dispatch: ALL k accum groups advance in lockstep
+    through ONE vmapped device call per step, and all k groups' actions
+    come back in ONE fused fetch.
+
+    The plain accum path pays one dispatch + one blocking action fetch
+    per group per step (runtime/accum_actor.py AccumVectorActor), so k
+    groups cost ~k link round-trips per step even with thread overlap;
+    the service path co-batches but round-trips full agent outputs
+    (runtime/actor.py).  This merges the two designs — accum's
+    upload-only link discipline with service's co-batching — so the
+    per-step link cost is ~1 RTT regardless of k.  The trade: groups
+    step in lockstep (the slowest group's env gates the batch), which
+    is the right trade exactly when the link RTT, not env variance,
+    dominates (any remote TPU attachment; BENCH_NOTES r3 measured
+    70-120 ms blocking fetches).
+
+    Trajectory layout, rng streams, and math are identical to
+    ``AccumVectorActor`` with the same per-group seeds
+    (tests/test_accum_actor.py asserts equivalence).
+    """
+
+    def __init__(self, programs: AccumPrograms, env_groups,
+                 level_name: str = "", seeds=None):
+        sizes = {envs.num_envs for envs in env_groups}
+        if sizes != {programs.batch}:
+            raise ValueError(
+                f"group sizes {sorted(sizes)} != programs batch "
+                f"{programs.batch}")
+        self._p = programs
+        self.envs_list = list(env_groups)
+        self.level_name = level_name
+        k = len(self.envs_list)
+        if seeds is None:
+            seeds = [1000 * i for i in range(k)]
+        if len(seeds) != k:
+            raise ValueError(f"{len(seeds)} seeds for {k} groups")
+        self._seeds = np.asarray(seeds, np.int32)  # [k]
+        self._counter = 0
+        self._bufs = None
+        self._core = None  # AgentState with [k, B, H] leaves
+        self._last_outs = None  # k host StepOutputs
+
+        # One fused program per phase, vmapped over the group axis.
+        # params/counter/slot are shared (in_axes None): lockstep means
+        # every group is always at the same slot with the same weights.
+        self.step = jax.jit(
+            jax.vmap(programs._step_impl,
+                     in_axes=(None, 0, None, None, 0, 0, 0, 0, 0)),
+            donate_argnums=(5,))
+        self.finish = jax.jit(
+            jax.vmap(programs._finish_impl), donate_argnums=(3,))
+        self.bootstrap = jax.jit(jax.vmap(programs._bootstrap_impl))
+
+    def _stacked_upload(self):
+        frames, packeds, extras = zip(*(
+            _upload_fields(self._p, out) for out in self._last_outs))
+        return (np.stack(frames), np.stack(packeds),
+                _stack_group_axis(list(extras)))
+
+    def run_unroll(self, params):
+        """One lockstep unroll -> list of k ActorOutputs (one per
+        group, each [T+1, B] on device)."""
+        p = self._p
+        k = len(self.envs_list)
+        if self._bufs is None:
+            self._last_outs = [envs.initial() for envs in self.envs_list]
+            self._bufs = self.bootstrap(*self._stacked_upload())
+            single = initial_state(p.batch, p.agent.core_size)
+            self._core = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x, (k,) + x.shape).copy(),
+                single)
+
+        first_core = self._core
+        core, bufs = self._core, self._bufs
+        for slot in range(1, p.unroll_length + 1):
+            self._counter += 1
+            frames, packeds, extras = self._stacked_upload()
+            actions_dev, core, bufs = self.step(
+                params, self._seeds, np.int32(self._counter),
+                np.int32(slot), frames, bufs, packeds, extras, core)
+            actions = np.asarray(actions_dev)  # ONE fetch for ALL groups
+            for envs, group_actions in zip(self.envs_list, actions):
+                envs.step_send(group_actions)
+            self._last_outs = [envs.step_recv()
+                               for envs in self.envs_list]
+
+        traj, self._bufs = self.finish(*self._stacked_upload(), bufs)
+        self._core = core
+        env_bufs, agent_bufs = traj
+        outputs = []
+        for i in range(k):
+            take = lambda x: None if x is None else x[i]
+            outputs.append(ActorOutput(
+                level_name=self.level_name,
+                agent_state=AgentState(c=first_core.c[i],
+                                       h=first_core.h[i]),
+                env_outputs=jax.tree_util.tree_map(
+                    take, env_bufs, is_leaf=lambda x: x is None),
+                agent_outputs=jax.tree_util.tree_map(
+                    take, agent_bufs, is_leaf=lambda x: x is None),
+            ))
+        return outputs
+
+    def close(self):
+        for envs in self.envs_list:
+            envs.close()

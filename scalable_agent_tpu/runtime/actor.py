@@ -180,6 +180,7 @@ class ActorPool:
         inference_device: Optional[jax.Device] = None,
         inference_mode: str = "structural",
         service_timeout_ms: float = 5.0,
+        observation_spec=None,
     ):
         # Inference runs on ONE device (by default the first): actor
         # threads must never launch multi-device SPMD programs — concurrent
@@ -211,16 +212,17 @@ class ActorPool:
             self._service_jit = jax.jit(functools.partial(
                 _service_step, agent))
             step_fn = self._service_request
-        elif inference_mode != "accum":
+        elif inference_mode not in ("accum", "accum_fused"):
             raise ValueError(f"unknown inference_mode {inference_mode!r}")
         self._inference_mode = inference_mode
-        if inference_mode == "accum":
+        if inference_mode in ("accum", "accum_fused"):
             # On-device trajectory accumulation: per step only flat frame
             # bytes go up and sampled actions come down; the trajectory
             # never re-crosses the link (runtime/accum_actor.py).
             from scalable_agent_tpu.runtime.accum_actor import (
                 AccumPrograms,
                 AccumVectorActor,
+                GroupedAccumActor,
             )
 
             sizes = {envs.num_envs for envs in env_groups}
@@ -228,14 +230,36 @@ class ActorPool:
                 raise ValueError(
                     f"accum inference needs uniform group sizes, got "
                     f"{sorted(sizes)}")
+            # Optional observation streams (instruction token ids,
+            # Doom measurement vectors) need device buffers sized from
+            # the spec — the driver passes its probed observation_spec
+            # so language/measurement levels work in accum mode.
+            instr_spec = getattr(observation_spec, "instruction", None)
+            meas_spec = getattr(observation_spec, "measurements", None)
             programs = AccumPrograms(
                 agent, unroll_length, env_groups[0].num_envs,
-                env_groups[0].frame_slab().shape[1:])
-            self._actors = [
-                AccumVectorActor(programs, envs, level_name=level_name,
-                                 seed=seed + 1000 * i)
-                for i, envs in enumerate(env_groups)
-            ]
+                env_groups[0].frame_slab().shape[1:],
+                instruction_shape=(tuple(instr_spec.shape)
+                                   if instr_spec is not None else None),
+                measurements_shape=(tuple(meas_spec.shape)
+                                    if meas_spec is not None else None))
+            if inference_mode == "accum_fused":
+                # Cross-group co-dispatch: ONE lockstep driver serves
+                # every group with one vmapped device call + one fused
+                # action fetch per step (~1 link RTT for k groups; see
+                # GroupedAccumActor).  Same per-group seeds as the
+                # threaded accum path, so trajectories are identical.
+                self._actors = [GroupedAccumActor(
+                    programs, env_groups, level_name=level_name,
+                    seeds=[seed + 1000 * i
+                           for i in range(len(env_groups))])]
+            else:
+                self._actors = [
+                    AccumVectorActor(programs, envs,
+                                     level_name=level_name,
+                                     seed=seed + 1000 * i)
+                    for i, envs in enumerate(env_groups)
+                ]
         else:
             self._actors = [
                 VectorActor(agent, envs, unroll_length,
@@ -362,13 +386,17 @@ class ActorPool:
         try:
             while not self._stop.is_set():
                 params = self._get_params()
-                trajectory = actor.run_unroll(params)
-                while not self._stop.is_set():
-                    try:
-                        self.queue.put(trajectory, timeout=0.1)
-                        break
-                    except queue_lib.Full:
-                        continue
+                result = actor.run_unroll(params)
+                # Grouped (co-dispatch) actors emit one trajectory per
+                # group per lockstep unroll.
+                items = result if isinstance(result, list) else [result]
+                for trajectory in items:
+                    while not self._stop.is_set():
+                        try:
+                            self.queue.put(trajectory, timeout=0.1)
+                            break
+                        except queue_lib.Full:
+                            continue
         except Exception as exc:  # surface in get_trajectory
             if self._stop.is_set():
                 return  # shutdown cascade (e.g. batcher closed) — benign
@@ -405,15 +433,24 @@ class ActorPool:
         for actor in self._actors:
             actor.close()
 
+    def _all_envs(self):
+        """Every MultiEnv behind every actor (grouped actors own
+        several)."""
+        out = []
+        for actor in self._actors:
+            out.extend(getattr(actor, "envs_list", None)
+                       or [actor._envs])
+        return out
+
     @property
     def num_envs(self) -> int:
-        return sum(a._envs.num_envs for a in self._actors)
+        return sum(envs.num_envs for envs in self._all_envs())
 
     def episode_stats(self):
         """Merged completed-episode (return, length) ring buffers."""
         stats = []
-        for actor in self._actors:
-            stats.extend(actor._envs.episode_stats)
+        for envs in self._all_envs():
+            stats.extend(envs.episode_stats)
         return stats
 
     def drain_level_stats(self):
@@ -426,8 +463,8 @@ class ActorPool:
         each-episode-counted-once semantics).  popleft is atomic, so
         actor threads can keep appending during the drain."""
         by_level = {}
-        for actor in self._actors:
-            queue = getattr(actor._envs, "level_episode_stats", None)
+        for envs in self._all_envs():
+            queue = getattr(envs, "level_episode_stats", None)
             if not queue:
                 continue
             while True:
