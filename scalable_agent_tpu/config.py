@@ -60,6 +60,9 @@ class Config:
     # unroll (ops/lstm_pallas.py) on a single-device TPU mesh, the
     # nn.scan path elsewhere.  Param trees are identical either way.
     core_impl: str = "auto"
+    # Pallas-core matmul operand precision: float32 (exact parity) or
+    # bfloat16 (2x MXU rate, f32 accumulation).  Ignored by core "xla".
+    core_matmul_dtype: str = "float32"
     use_instruction: bool = False
     # (the actor-group count is derived: num_actors // batch_size — each
     # group is one learner batch; >= 2 groups overlap env-sim with TPU

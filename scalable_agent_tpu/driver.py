@@ -109,12 +109,26 @@ def resolve_core_impl(config: Config) -> str:
 def build_agent(config: Config, action_space) -> ImpalaAgent:
     """Policy heads derive from the probed action space — one Discrete
     head or a composite tuple-categorical (ops/distributions.py)."""
+    if config.core_matmul_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"core_matmul_dtype must be float32 or bfloat16, got "
+            f"{config.core_matmul_dtype!r}")
+    core_impl = resolve_core_impl(config)
+    if config.core_matmul_dtype != "float32" and core_impl != "pallas":
+        import warnings
+
+        warnings.warn(
+            f"core_matmul_dtype={config.core_matmul_dtype!r} only "
+            f"affects the pallas core; this run resolves to "
+            f"core_impl={core_impl!r} and trains at float32",
+            stacklevel=2)
     return ImpalaAgent(
         action_space=action_space,
         torso_type=config.torso_type,
         use_instruction=config.use_instruction,
         compute_dtype=jnp.dtype(config.compute_dtype),
-        core_impl=resolve_core_impl(config),
+        core_impl=core_impl,
+        core_matmul_dtype=config.core_matmul_dtype,
     )
 
 

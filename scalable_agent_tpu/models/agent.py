@@ -120,6 +120,7 @@ class _PallasCore(nn.Module):
     produce params under core/lstm/{ii..ho}."""
 
     features: int
+    matmul_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, carry, x, done):
@@ -131,7 +132,8 @@ class _PallasCore(nn.Module):
             self.features, x.shape[-1], name="lstm")()
         ys, (ct, ht) = lstm_pallas.lstm_unroll(
             jnp.asarray(x, jnp.float32), done, carry[0], carry[1],
-            wi, wh, b, jax.default_backend() != "tpu")
+            wi, wh, b, jax.default_backend() != "tpu",
+            self.matmul_dtype)
         return (ct, ht), ys
 
 
@@ -157,6 +159,10 @@ class ImpalaAgent(nn.Module):
     # "pallas" = the fused single-program unroll (ops/lstm_pallas.py).
     # Parameter trees are identical, so checkpoints are interchangeable.
     core_impl: str = "xla"
+    # Operand precision for the Pallas core's gate/BPTT matmuls:
+    # "float32" (bit-exact vs the flax cell) or "bfloat16" (2x MXU
+    # rate, f32 accumulation).  Ignored by the xla core.
+    core_matmul_dtype: str = "float32"
     # Composite policies: a TupleSpace mixing Discrete/Discretized
     # components (reference: TupleActionDistribution,
     # algorithms/utils/action_distributions.py:111-201).  When unset, the
@@ -220,8 +226,9 @@ class ImpalaAgent(nn.Module):
         carry = (core_state.c, core_state.h)
         done_f32 = jnp.asarray(done, jnp.float32)
         if self.core_impl == "pallas":
-            carry, core_outputs = _PallasCore(self.core_size, name="core")(
-                carry, torso_out, done_f32)
+            carry, core_outputs = _PallasCore(
+                self.core_size, matmul_dtype=self.core_matmul_dtype,
+                name="core")(carry, torso_out, done_f32)
         elif self.core_impl == "xla":
             scan = nn.scan(
                 _CoreStep,

@@ -29,8 +29,13 @@ offset), so the flax cell and this kernel are interchangeable on the
 same parameter pytree — see models/agent.py, which concatenates the
 cell's ii/if/ig/io and hi/hf/hg/ho kernels into Wi/Wh.
 
-All math is float32 (the flax cell promotes to the params' dtype —
-float32 — regardless of a bfloat16 torso, so parity holds exactly).
+Carry/gate math is float32.  The four matmuls (the kernel's only MXU
+work) run at a configurable precision: ``matmul_dtype="float32"``
+(default — bit-exact parity with the flax cell, which promotes to the
+f32 params' dtype regardless of a bfloat16 torso) or ``"bfloat16"``
+(operands cast to bf16, accumulation still f32 via
+``preferred_element_type`` — 2x the MXU rate at ~1e-2 relative gate
+error, the standard mixed-precision recipe).
 """
 
 import functools
@@ -42,8 +47,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _mm(a, b, matmul_dtype):
+    """MXU matmul at the configured operand precision, f32 accumulate."""
+    return jnp.dot(a.astype(matmul_dtype), b.astype(matmul_dtype),
+                   preferred_element_type=jnp.float32)
+
+
 def _cell_step(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref,
-               c_s, h_s):
+               c_s, h_s, matmul_dtype):
     """Shared cell math for one grid step: reset the carry where done,
     run the gates, update the VMEM carry.  Returns the intermediates
     the residual-producing kernel stashes for BPTT."""
@@ -59,8 +70,8 @@ def _cell_step(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref,
     h = keep * h_s[:]
 
     gates = (
-        jnp.dot(x_ref[0], wi_ref[:], preferred_element_type=jnp.float32)
-        + jnp.dot(h, wh_ref[:], preferred_element_type=jnp.float32)
+        _mm(x_ref[0], wi_ref[:], matmul_dtype)
+        + _mm(h, wh_ref[:], matmul_dtype)
         + b_ref[0][None, :])
     hidden = c.shape[-1]
     i = jax.nn.sigmoid(gates[:, :hidden])
@@ -76,13 +87,15 @@ def _cell_step(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref,
 
 
 def _fwd_kernel_lean(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref,
-                     b_ref, ys_ref, ct_ref, ht_ref, c_s, h_s):
+                     b_ref, ys_ref, ct_ref, ht_ref, c_s, h_s,
+                     matmul_dtype=jnp.float32):
     """Inference-only forward: writes just ys and the final carry — no
     residual traffic (the primal path of lstm_unroll; XLA cannot DCE
     individual outputs of one kernel, so the residual variant would pay
     ~7x the HBM writes for nothing outside a grad context)."""
     _, _, _, _, _, _, c_new, h_new = _cell_step(
-        x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref, c_s, h_s)
+        x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref, c_s, h_s,
+        matmul_dtype)
     ys_ref[0] = h_new
     # Constant-index output block: the last grid step's write survives.
     ct_ref[:] = c_new
@@ -91,12 +104,13 @@ def _fwd_kernel_lean(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref,
 
 def _fwd_kernel(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref,
                 ys_ref, ifgo_ref, cpost_ref, hpost_ref, cnew_ref,
-                ct_ref, ht_ref, c_s, h_s):
+                ct_ref, ht_ref, c_s, h_s, matmul_dtype=jnp.float32):
     """Residual-producing forward (the VJP primal): additionally stashes
     the gate activations ifgo [1,B,4H], post-reset carries cpost/hpost
     [1,B,H], and cnew [1,B,H] per timestep for the backward kernel."""
     c, h, i, f, g, o, c_new, h_new = _cell_step(
-        x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref, c_s, h_s)
+        x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref, c_s, h_s,
+        matmul_dtype)
     cpost_ref[0] = c
     hpost_ref[0] = h
     ifgo_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
@@ -109,7 +123,8 @@ def _fwd_kernel(x_ref, done_ref, c0_ref, h0_ref, wi_ref, wh_ref, b_ref,
 def _bwd_kernel(dys_ref, x_ref, done_ref, ifgo_ref, cpost_ref, hpost_ref,
                 cnew_ref, wi_ref, wh_ref, dct_ref, dht_ref,
                 dx_ref, dwi_ref, dwh_ref, db_ref, dc0_ref, dh0_ref,
-                dc_s, dh_s, dwi_s, dwh_s, db_s):
+                dc_s, dh_s, dwi_s, dwh_s, db_s,
+                matmul_dtype=jnp.float32):
     """One reverse timestep of BPTT (grid step k visits t = T-1-k via the
     index maps; inside the kernel every per-t ref is already the t-th
     block)."""
@@ -141,19 +156,18 @@ def _bwd_kernel(dys_ref, x_ref, done_ref, ifgo_ref, cpost_ref, hpost_ref,
     dgates = jnp.concatenate([di, df, dg, do], axis=-1)   # [B, 4H]
 
     # dx = dgates @ Wi^T ; dh_prev = dgates @ Wh^T  (contract gate dim).
+    mm = lambda a, b, dims: lax.dot_general(
+        a.astype(matmul_dtype), b.astype(matmul_dtype), dims,
+        preferred_element_type=jnp.float32)
     contract_last = (((1,), (1,)), ((), ()))
-    dx_ref[0] = lax.dot_general(dgates, wi_ref[:], contract_last,
-                                preferred_element_type=jnp.float32)
-    dh_prev = lax.dot_general(dgates, wh_ref[:], contract_last,
-                              preferred_element_type=jnp.float32)
+    dx_ref[0] = mm(dgates, wi_ref[:], contract_last)
+    dh_prev = mm(dgates, wh_ref[:], contract_last)
     dc_prev = dc * f
 
     # Weight grads: x^T @ dgates and h_post^T @ dgates (contract batch).
     contract_batch = (((0,), (0,)), ((), ()))
-    dwi_s[:] += lax.dot_general(x_ref[0], dgates, contract_batch,
-                                preferred_element_type=jnp.float32)
-    dwh_s[:] += lax.dot_general(hpost_ref[0], dgates, contract_batch,
-                                preferred_element_type=jnp.float32)
+    dwi_s[:] += mm(x_ref[0], dgates, contract_batch)
+    dwh_s[:] += mm(hpost_ref[0], dgates, contract_batch)
     db_s[:] += jnp.sum(dgates, axis=0, keepdims=True)
 
     # Chain through the pre-step reset: grads vanish where done was 1.
@@ -170,7 +184,8 @@ def _bwd_kernel(dys_ref, x_ref, done_ref, ifgo_ref, cpost_ref, hpost_ref,
     dh0_ref[:] = dh_s[:]
 
 
-def _fwd_call(x, done, c0, h0, wi, wh, b, *, interpret, with_residuals):
+def _fwd_call(x, done, c0, h0, wi, wh, b, *, interpret, with_residuals,
+              matmul_dtype=jnp.float32):
     unroll_len, batch, in_dim = x.shape
     hidden = c0.shape[-1]
     f32 = jnp.float32
@@ -198,7 +213,7 @@ def _fwd_call(x, done, c0, h0, wi, wh, b, *, interpret, with_residuals):
         out_specs = (t_spec(batch, hidden), carry_spec, carry_spec)
         out_shape = (tb(batch, hidden), carry_shape, carry_shape)
     return pl.pallas_call(
-        kernel,
+        functools.partial(kernel, matmul_dtype=matmul_dtype),
         grid=(unroll_len,),
         in_specs=[
             t_spec(batch, in_dim),           # x
@@ -219,7 +234,8 @@ def _fwd_call(x, done, c0, h0, wi, wh, b, *, interpret, with_residuals):
     )(x, done[..., None], c0, h0, wi, wh, b.reshape(1, -1))
 
 
-def _bwd_call(residuals, cotangents, *, interpret):
+def _bwd_call(residuals, cotangents, *, interpret,
+              matmul_dtype=jnp.float32):
     x, done, wi, wh, ifgo, cpost, hpost, cnew = residuals
     dys, dct, dht = cotangents
     unroll_len, batch, in_dim = x.shape
@@ -229,7 +245,7 @@ def _bwd_call(residuals, cotangents, *, interpret):
         (1,) + shape, lambda k: (unroll_len - 1 - k,) + (0,) * len(shape))
     const = lambda *shape: pl.BlockSpec(shape, lambda k: (0,) * len(shape))
     return pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, matmul_dtype=matmul_dtype),
         grid=(unroll_len,),
         in_specs=[
             rev(batch, hidden),              # dys
@@ -271,33 +287,49 @@ def _bwd_call(residuals, cotangents, *, interpret):
     )(dys, x, done[..., None], ifgo, cpost, hpost, cnew, wi, wh, dct, dht)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
-def lstm_unroll(x, done, c0, h0, wi, wh, b, interpret=False):
+def _resolve_matmul_dtype(matmul_dtype):
+    dtype = jnp.dtype(matmul_dtype)
+    if dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"matmul_dtype must be float32 or bfloat16, got {dtype}")
+    return dtype
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def lstm_unroll(x, done, c0, h0, wi, wh, b, interpret=False,
+                matmul_dtype="float32"):
     """Fused done-reset LSTM unroll.
 
     x [T,B,D] float32, done [T,B] float32 (1.0 resets the carry BEFORE
     the step), c0/h0 [B,H], wi [D,4H], wh [H,4H], b [4H] in flax
     OptimizedLSTMCell's (i,f,g,o) gate order.  Returns
     (ys [T,B,H], (cT, hT)).  Differentiable in everything but ``done``.
+
+    ``matmul_dtype``: operand precision for the gate/BPTT matmuls —
+    "float32" (bit-exact vs the flax cell) or "bfloat16" (2x MXU rate,
+    f32 accumulation).
     """
     ys, ct, ht = _fwd_call(
         x, done, c0, h0, wi, wh, b, interpret=interpret,
-        with_residuals=False)
+        with_residuals=False,
+        matmul_dtype=_resolve_matmul_dtype(matmul_dtype))
     return ys, (ct, ht)
 
 
-def _vjp_fwd(x, done, c0, h0, wi, wh, b, interpret):
+def _vjp_fwd(x, done, c0, h0, wi, wh, b, interpret, matmul_dtype):
     ys, ifgo, cpost, hpost, cnew, ct, ht = _fwd_call(
         x, done, c0, h0, wi, wh, b, interpret=interpret,
-        with_residuals=True)
+        with_residuals=True,
+        matmul_dtype=_resolve_matmul_dtype(matmul_dtype))
     residuals = (x, done, wi, wh, ifgo, cpost, hpost, cnew)
     return (ys, (ct, ht)), residuals
 
 
-def _vjp_bwd(interpret, residuals, cotangents):
+def _vjp_bwd(interpret, matmul_dtype, residuals, cotangents):
     dys, (dct, dht) = cotangents
     dx, dwi, dwh, db, dc0, dh0 = _bwd_call(
-        residuals, (dys, dct, dht), interpret=interpret)
+        residuals, (dys, dct, dht), interpret=interpret,
+        matmul_dtype=_resolve_matmul_dtype(matmul_dtype))
     ddone = jnp.zeros_like(residuals[1])  # non-differentiable data input
     return dx, ddone, dc0, dh0, dwi, dwh, db.reshape(-1)
 
