@@ -14,3 +14,7 @@ from scalable_agent_tpu.parallel.distributed import (
     is_coordinator,
     local_batch_size,
 )
+from scalable_agent_tpu.parallel.pipeline import (
+    gpipe_spmd,
+    pipeline_utilization,
+)

@@ -1,0 +1,16 @@
+"""Small jax version shims shared by the parallel package."""
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.8
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+from jax import lax
+
+
+def mark_varying(x, axis_name):
+    """Type ``x`` as device-varying over ``axis_name`` inside shard_map
+    (needed e.g. for a scan carry that meets a ppermute output)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, (axis_name,))  # pre-pcast jax

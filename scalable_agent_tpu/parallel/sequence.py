@@ -90,10 +90,7 @@ def from_importance_weights_sharded(
     must divide evenly by the axis size.  Numerics match the
     single-device associative path (same composition order).
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # jax < 0.8
-        from jax.experimental.shard_map import shard_map
+    from scalable_agent_tpu.parallel._compat import shard_map
 
     log_rhos = jnp.asarray(log_rhos, jnp.float32)
     discounts = jnp.asarray(discounts, jnp.float32)
