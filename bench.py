@@ -347,9 +347,13 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
     # +1-lag overlap), while fused mode needs num_groups — it emits all
     # k trajectories at once, and a smaller queue would stall the
     # lockstep driver mid-handoff and lose its learner overlap.
+    fused_shards = int(os.environ.get("BENCH_E2E_SHARDS", "1"))
+    if inference_mode == "accum_fused":
+        diag["e2e_config"]["fused_shards"] = fused_shards
     pool = ActorPool(agent, groups, unroll_len,
                      level_name="fake_benchmark",
                      inference_mode=inference_mode,
+                     fused_shards=fused_shards,
                      queue_capacity=(num_groups
                                      if inference_mode == "accum_fused"
                                      else 2))

@@ -88,6 +88,11 @@ class Config:
     # device call and ONE action fetch serve all groups per step —
     # ~1 link RTT regardless of group count).
     inference_mode: str = "structural"
+    # accum_fused only: number of lockstep shards the group fleet
+    # splits into (separate threads).  1 = one device call serves ALL
+    # groups (minimum RTTs); 2 lets one shard's env stepping overlap
+    # the other's link round trip.
+    accum_fused_shards: int = 1
     # Training backend: "host" (actor pool + prefetch + learner — the
     # reference's architecture, experiment.py:479-672) or "ingraph"
     # (rollout + update fused into ONE jitted device program for

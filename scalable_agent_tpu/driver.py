@@ -193,6 +193,28 @@ def zero_trajectory(config: Config, observation_spec, agent: ImpalaAgent,
     )
 
 
+def match_port_scheme(total_matches: int):
+    """UDP port scheme shared by every concurrent-match constructor
+    (training groups AND eval fleets): each match probes its own
+    residue class — base ``DEFAULT_UDP_PORT + stride*index``, increment
+    ``stride*total`` — so concurrent inits can't race each other, with
+    >= ~4 retry probes per match kept under the 65536 ceiling.
+
+    Returns ``stride``; raises when ``total_matches`` exhausts the port
+    space above DEFAULT_UDP_PORT."""
+    from scalable_agent_tpu.envs.doom.multiplayer import DEFAULT_UDP_PORT
+
+    stride = max(1, min(1000, 25000 // max(1, 8 * total_matches)))
+    retries = (65536 - DEFAULT_UDP_PORT - stride * total_matches) // (
+        stride * total_matches)
+    if retries < 2:
+        raise ValueError(
+            f"{total_matches} concurrent matches do not fit the UDP "
+            f"port space above {DEFAULT_UDP_PORT} with retry headroom; "
+            f"reduce the fleet or lower DOOM_DEFAULT_UDP_PORT")
+    return stride
+
+
 def make_env_groups(config: Config, frame_spec: TensorSpec,
                     num_agents: int = 1,
                     level_names: Optional[List[str]] = None
@@ -241,19 +263,7 @@ def make_env_groups(config: Config, frame_spec: TensorSpec,
         # init (any host) can't race another match's host.
         proc = jax.process_index()
         total_global = num_groups * matches * jax.process_count()
-        # Every match probes its own residue class (base + k*increment
-        # stays disjoint from other matches') AND must keep >= ~4 retry
-        # probes under 65536, so the stride shrinks with 8x headroom as
-        # the global match count grows.
-        stride = max(1, min(1000, 25000 // max(1, 8 * total_global)))
-        retries = (65536 - DEFAULT_UDP_PORT - stride * total_global) // (
-            stride * total_global)
-        if retries < 2:
-            raise ValueError(
-                f"{total_global} global matches do not fit the UDP port "
-                f"space above {DEFAULT_UDP_PORT} with retry headroom; "
-                f"reduce num_actors / batch_size or lower "
-                f"DOOM_DEFAULT_UDP_PORT")
+        stride = match_port_scheme(total_global)
 
         def match_index(g: int, m: int) -> int:
             return proc * num_groups * matches + g * matches + m
@@ -409,7 +419,8 @@ def train(config: Config) -> Dict[str, float]:
     pool = ActorPool(agent, env_groups, config.unroll_length,
                      level_name=config.level_name, seed=config.seed,
                      inference_mode=config.inference_mode,
-                     observation_spec=observation_spec)
+                     observation_spec=observation_spec,
+                     fused_shards=config.accum_fused_shards)
     pool.set_params(state.params)
     pool.start()
 
@@ -688,28 +699,17 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     return {k: _host_scalar(v) for k, v in metrics.items()}
 
 
-def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
-                level_name: str, frame_spec: TensorSpec,
-                num_episodes: int) -> List[float]:
-    """Collect ``num_episodes`` returns with a BATCHED eval fleet: a
-    MultiEnv of ``test_batch_size`` envs stepped under one jitted [B]
-    inference call (the reference evaluates batch-1 synchronously,
-    experiment.py:691-701 — this is the same protocol at fleet width)."""
-    batch = max(1, min(num_episodes, config.test_batch_size))
-    fns = [
-        functools.partial(
-            make_impala_stream, level_name,
-            seed=config.seed * 977 + 131 * i,
-            num_action_repeats=config.num_action_repeats,
-            **env_kwargs(config, level_name))
-        for i in range(batch)
-    ]
-    envs = MultiEnv(fns, frame_spec,
-                    num_workers=min(batch, config.test_num_workers))
-    # Fixed per-env episode quota: taking the global first-N completions
-    # would overrepresent short episodes (fast finishers complete more
-    # often), biasing mean returns vs the reference's one-env sequential
-    # protocol.  Each env contributes at most ceil(N / batch) episodes.
+def _eval_loop(envs, config: Config, agent: ImpalaAgent, params, step_fn,
+               num_episodes: int) -> List[float]:
+    """Drive any MultiEnv-protocol fleet (initial/step_send/step_recv)
+    under one jitted [B] inference call until ``num_episodes`` episodes
+    complete.
+
+    Fixed per-slot episode quota: taking the global first-N completions
+    would overrepresent short episodes (fast finishers complete more
+    often), biasing mean returns vs the reference's one-env sequential
+    protocol.  Each slot contributes at most ceil(N / B) episodes."""
+    batch = envs.num_envs
     quota = -(-num_episodes // batch)
     counts = np.zeros((batch,), np.int64)
     returns: List[float] = []
@@ -737,6 +737,63 @@ def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
     return returns[:num_episodes]
 
 
+def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
+                level_name: str, frame_spec: TensorSpec,
+                num_episodes: int) -> List[float]:
+    """Collect ``num_episodes`` returns with a BATCHED eval fleet: a
+    MultiEnv of ``test_batch_size`` envs stepped under one jitted [B]
+    inference call (the reference evaluates batch-1 synchronously,
+    experiment.py:691-701 — this is the same protocol at fleet width)."""
+    batch = max(1, min(num_episodes, config.test_batch_size))
+    fns = [
+        functools.partial(
+            make_impala_stream, level_name,
+            seed=config.seed * 977 + 131 * i,
+            num_action_repeats=config.num_action_repeats,
+            **env_kwargs(config, level_name))
+        for i in range(batch)
+    ]
+    envs = MultiEnv(fns, frame_spec,
+                    num_workers=min(batch, config.test_num_workers))
+    return _eval_loop(envs, config, agent, params, step_fn, num_episodes)
+
+
+def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
+                      num_agents: int, num_episodes: int) -> List[float]:
+    """Self-play eval for lockstep multi-agent levels: K matches of A
+    agents, every slot driven by the SAME policy under one jitted [K*A]
+    call; per-slot episode returns pool into the result (the reference
+    has no multi-agent eval at all — this goes beyond parity).
+    """
+    from scalable_agent_tpu.envs.doom.multiplayer import (
+        DEFAULT_UDP_PORT,
+        MultiAgentVectorEnv,
+    )
+
+    matches = max(1, config.test_batch_size // num_agents)
+    if matches * num_agents != config.test_batch_size:
+        # Eval batch is throughput sizing, not a correctness property
+        # (unlike the training batch, where make_env_groups raises) —
+        # round down to whole matches, loudly.
+        log.info(
+            "test_batch_size %d is not a multiple of num_agents %d; "
+            "evaluating %d matches (%d agent slots)",
+            config.test_batch_size, num_agents, matches,
+            matches * num_agents)
+    stride = match_port_scheme(matches)
+    envs = MultiAgentVectorEnv([
+        functools.partial(
+            create_env, config.level_name,
+            num_action_repeats=config.num_action_repeats,
+            seed=config.seed * matches + m,
+            port_base=DEFAULT_UDP_PORT + stride * m,
+            port_increment=stride * matches,
+            **env_kwargs(config))
+        for m in range(matches)
+    ])
+    return _eval_loop(envs, config, agent, params, step_fn, num_episodes)
+
+
 def test(config: Config) -> Dict[str, List[float]]:
     """Evaluate a checkpoint: test_num_episodes per level, batched.
 
@@ -753,10 +810,6 @@ def test(config: Config) -> Dict[str, List[float]]:
     probe_config = (dataclasses.replace(config, level_name=level_names[0])
                     if suite else config)
     observation_spec, action_space, num_agents = probe_env(probe_config)
-    if num_agents > 1:
-        raise ValueError(
-            "multi-agent levels are not supported in eval mode "
-            "(the reference's eval path is single-agent too)")
     agent = build_agent(config, action_space)
 
     # Restore against a structure template so optimizer-state NamedTuples
@@ -781,6 +834,18 @@ def test(config: Config) -> Dict[str, List[float]]:
             agent, params, rng, action, env_output, state))
 
     level_returns: Dict[str, List[float]] = {}
+    if num_agents > 1:
+        # Self-play multi-agent eval (suite levels are never
+        # multi-agent, so this is always the single-level path).
+        returns = _eval_multi_agent(
+            config, agent, params, step_fn, num_agents,
+            config.test_num_episodes)
+        level_returns[config.level_name] = returns
+        log.info("multi-agent level %s: mean self-play return %.2f "
+                 "over %d agent-episodes",
+                 config.level_name, float(np.mean(returns)),
+                 len(returns))
+        return level_returns
     for level_name in level_names:
         returns = _eval_level(
             config, agent, params, step_fn, level_name,

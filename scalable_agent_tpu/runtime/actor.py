@@ -181,6 +181,7 @@ class ActorPool:
         inference_mode: str = "structural",
         service_timeout_ms: float = 5.0,
         observation_spec=None,
+        fused_shards: int = 1,
     ):
         # Inference runs on ONE device (by default the first): actor
         # threads must never launch multi-device SPMD programs — concurrent
@@ -244,15 +245,33 @@ class ActorPool:
                 measurements_shape=(tuple(meas_spec.shape)
                                     if meas_spec is not None else None))
             if inference_mode == "accum_fused":
-                # Cross-group co-dispatch: ONE lockstep driver serves
-                # every group with one vmapped device call + one fused
-                # action fetch per step (~1 link RTT for k groups; see
-                # GroupedAccumActor).  Same per-group seeds as the
-                # threaded accum path, so trajectories are identical.
-                self._actors = [GroupedAccumActor(
-                    programs, env_groups, level_name=level_name,
-                    seeds=[seed + 1000 * i
-                           for i in range(len(env_groups))])]
+                # Cross-group co-dispatch: a lockstep driver serves its
+                # groups with one vmapped device call + one fused
+                # action fetch per step (~1 link RTT for its k groups;
+                # see GroupedAccumActor).  ``fused_shards`` > 1 splits
+                # the fleet into that many lockstep drivers on separate
+                # threads, so one shard's env stepping/upload overlaps
+                # another's link round trip — the middle ground between
+                # fully-threaded accum (k RTTs) and one lockstep batch
+                # (no overlap).  Same per-group seeds as the threaded
+                # path either way, so trajectories are identical.
+                shards = max(1, min(fused_shards, len(env_groups)))
+                # Balanced split: exactly ``shards`` drivers (e.g. 4
+                # groups over 3 shards -> [2, 1, 1]), so the config
+                # value means what it says.
+                base, extra = divmod(len(env_groups), shards)
+                sizes = [base + (1 if s < extra else 0)
+                         for s in range(shards)]
+                bounds = [0]
+                for size in sizes:
+                    bounds.append(bounds[-1] + size)
+                self._actors = [
+                    GroupedAccumActor(
+                        programs, env_groups[lo:hi],
+                        level_name=level_name,
+                        seeds=[seed + 1000 * i for i in range(lo, hi)])
+                    for lo, hi in zip(bounds, bounds[1:])
+                ]
             else:
                 self._actors = [
                     AccumVectorActor(programs, envs,

@@ -248,6 +248,34 @@ class TestGroupedCoDispatch:
             for g in groups_fused + groups_solo:
                 g.close()
 
+    def test_fused_shards_split_fleet_and_match(self, agent_and_params):
+        """fused_shards=2 over 3 groups -> two lockstep drivers (2+1
+        groups) whose trajectories still match the threaded path's
+        per-group seeds."""
+        agent, params = agent_and_params
+        groups = [make_envs(B, workers=1) for _ in range(3)]
+        solo_groups = [make_envs(B, workers=1) for _ in range(3)]
+        pool = ActorPool(agent, groups, unroll_length=T, seed=11,
+                         inference_mode="accum_fused", fused_shards=2)
+        try:
+            assert len(pool._actors) == 2
+            assert [len(a.envs_list) for a in pool._actors] == [2, 1]
+            programs = pool._actors[0]._p
+            solos = [AccumVectorActor(programs, envs, seed=11 + 1000 * i)
+                     for i, envs in enumerate(solo_groups)]
+            fused_outs = (pool._actors[0].run_unroll(params)
+                          + pool._actors[1].run_unroll(params))
+            for f, s in zip(fused_outs,
+                            [a.run_unroll(params) for a in solos]):
+                np.testing.assert_array_equal(
+                    np.asarray(f.agent_outputs.action),
+                    np.asarray(s.agent_outputs.action))
+        finally:
+            for g in groups + solo_groups:
+                g.close()
+            for actor in pool._actors:
+                actor.envs_list = []  # groups already closed above
+
     def test_pool_accum_fused_feeds_learner(self, agent_and_params):
         """End-to-end: ActorPool(inference_mode='accum_fused') -> Learner
         with per-group trajectories arriving through the queue."""

@@ -682,6 +682,31 @@ class TestDriverMultiAgent:
         assert np.isfinite(metrics["total_loss"])
         assert metrics["env_frames"] == config.total_environment_frames
 
+    @pytest.mark.slow
+    def test_multiagent_eval_after_train(self, tmp_path):
+        """--mode=test on a multi-agent level: self-play eval over
+        lockstep matches (beyond the reference, whose eval path is
+        single-agent only)."""
+        from scalable_agent_tpu.config import Config
+        from scalable_agent_tpu.driver import test as run_test
+        from scalable_agent_tpu.driver import train
+
+        logdir = str(tmp_path / "logs")
+        common = dict(
+            logdir=logdir, level_name="doom_duel",
+            num_actors=4, batch_size=2, unroll_length=3,
+            num_action_repeats=4, compute_dtype="float32",
+            checkpoint_interval_s=0.0,
+        )
+        train(Config(mode="train",
+                     total_environment_frames=2 * 3 * 2 * 4, **common))
+        returns = run_test(Config(
+            mode="test", test_num_episodes=4, test_batch_size=4,
+            **common))
+        assert list(returns) == ["doom_duel"]
+        assert len(returns["doom_duel"]) == 4
+        assert all(np.isfinite(r) for r in returns["doom_duel"])
+
     def test_batch_size_must_divide_by_agents(self, tmp_path):
         from scalable_agent_tpu.config import Config
         from scalable_agent_tpu.driver import make_env_groups
