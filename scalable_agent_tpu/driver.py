@@ -681,22 +681,39 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             frames += frames_per_update
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:
-                host_metrics = {k: _host_scalar(v)
-                                for k, v in metrics.items()}
+                host_metrics = _finalize_ingraph_metrics(metrics, config)
                 fps = (frames - frames_at_last_log) / (now - last_log)
                 host_metrics["fps"] = fps
                 writer.write(updates, host_metrics)
                 log.info(
-                    "update %d frames %.3g fps %.0f loss %.3f | %s",
+                    "update %d frames %.3g fps %.0f loss %.3f return "
+                    "%s | %s",
                     updates, frames, fps,
-                    host_metrics.get("total_loss", float("nan")), timing)
+                    host_metrics.get("total_loss", float("nan")),
+                    f"{host_metrics.get('episode_return', float('nan')):.2f}",
+                    timing)
                 last_log, frames_at_last_log = now, frames
             ckpt.maybe_save(updates, state)
         ckpt.maybe_save(updates, state, force=True)
     finally:
         writer.close()
         ckpt.close()
-    return {k: _host_scalar(v) for k, v in metrics.items()}
+    return _finalize_ingraph_metrics(metrics, config)
+
+
+def _finalize_ingraph_metrics(metrics, config: Config) -> Dict[str, float]:
+    """Device metrics -> host dict with the episode-stat contract the
+    host backend keeps: per-unroll episode means appear only when
+    episodes actually finished, and frames are simulator frames
+    (agent steps x num_action_repeats).  Applied to BOTH the logged
+    rows and train_ingraph's return value so they can never disagree."""
+    host_metrics = {k: _host_scalar(v) for k, v in metrics.items()}
+    if host_metrics.pop("episodes_completed", 0) < 1:
+        host_metrics.pop("episode_return", None)
+        host_metrics.pop("episode_frames", None)
+    elif "episode_frames" in host_metrics:
+        host_metrics["episode_frames"] *= config.num_action_repeats
+    return host_metrics
 
 
 def _eval_loop(envs, config: Config, agent: ImpalaAgent, params, step_fn,
@@ -780,14 +797,20 @@ def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
             "evaluating %d matches (%d agent slots)",
             config.test_batch_size, num_agents, matches,
             matches * num_agents)
-    stride = match_port_scheme(matches)
+    # Globally-unique port residue classes across a multi-process job
+    # (same invariant make_env_groups enforces for training), and eval
+    # seeds DECORRELATED from training's seed formula (977/131 mixing,
+    # like _eval_level) so eval matches never replay trained env seeds.
+    proc = jax.process_index()
+    total = matches * jax.process_count()
+    stride = match_port_scheme(total)
     envs = MultiAgentVectorEnv([
         functools.partial(
             create_env, config.level_name,
             num_action_repeats=config.num_action_repeats,
-            seed=config.seed * matches + m,
-            port_base=DEFAULT_UDP_PORT + stride * m,
-            port_increment=stride * matches,
+            seed=config.seed * 977 + 131 * (proc * matches + m),
+            port_base=DEFAULT_UDP_PORT + stride * (proc * matches + m),
+            port_increment=stride * total,
             **env_kwargs(config))
         for m in range(matches)
     ])
@@ -803,6 +826,19 @@ def test(config: Config) -> Dict[str, List[float]]:
     (reference: experiment.py:675-708 + :716-717).
     """
     config = apply_env_overrides(config)
+    # The network architecture is a property of the CHECKPOINT, not of
+    # the eval-time level: adopt the trained run's architecture fields
+    # from its persisted config so e.g. a no-instruction checkpoint
+    # evaluates under --level_name=dmlab30 (whose env override would
+    # otherwise grow an instruction tower the restore can't match).
+    saved_path = os.path.join(config.logdir, "config.json")
+    if os.path.exists(saved_path):
+        saved = Config.load(saved_path)
+        config = dataclasses.replace(config, **{
+            field: getattr(saved, field)
+            for field in ("torso_type", "use_instruction", "core_impl",
+                          "core_matmul_dtype", "compute_dtype")
+        })
     suite = config.level_name == "dmlab30"
     level_names = ([f"dmlab_{name}" for name in dmlab30.TEST_LEVELS]
                    if suite else [config.level_name])

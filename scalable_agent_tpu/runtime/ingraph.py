@@ -149,6 +149,21 @@ class InGraphTrainer:
         carry = self._constrain_batch(carry)
         trajectory, new_carry = self._rollout(state.params, carry, rng)
         new_state, metrics = self._learner._update_impl(state, trajectory)
+        # Episode accounting from the on-device env stream (the host
+        # backend reads MultiEnv ring buffers; here the trajectory
+        # itself carries the emitted per-done episode stats).  Consumers
+        # gate on episodes_completed > 0 before trusting the means.
+        done = trajectory.env_outputs.done[1:]
+        steps = trajectory.env_outputs.info.episode_step[1:]
+        finished = jnp.logical_and(done, steps > 0)
+        count = jnp.sum(finished)
+        denom = jnp.maximum(count, 1).astype(jnp.float32)
+        metrics["episodes_completed"] = count
+        metrics["episode_return"] = jnp.sum(jnp.where(
+            finished, trajectory.env_outputs.info.episode_return[1:],
+            0.0)) / denom
+        metrics["episode_frames"] = jnp.sum(jnp.where(
+            finished, steps, 0)).astype(jnp.float32) / denom
         return new_state, new_carry, metrics
 
     # -- host loop ---------------------------------------------------------

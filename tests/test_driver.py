@@ -194,6 +194,31 @@ class TestInGraphBackend:
         # One more 80-frame update, not a from-scratch retrain.
         assert rows_after - len(rows) == 1
 
+    def test_ingraph_reports_episode_metrics(self, tmp_path):
+        """The fused path logs device-computed episode stats (metrics
+        parity with the host backend's ring-buffer means)."""
+        config = small_config(
+            tmp_path, train_backend="ingraph", level_name="fake_small",
+            num_actors=4, batch_size=4, unroll_length=5,
+            num_action_repeats=2,
+            # 6 updates of 40 frames; fake_small episodes last 10
+            # agent steps, so episodes finish from update 2 on.
+            total_environment_frames=240,
+            checkpoint_interval_s=1e9)
+        run_train(config)
+        rows = [json.loads(line) for line in
+                open(os.path.join(config.logdir, "metrics.jsonl"))]
+        with_stats = [r for r in rows if "episode_return" in r]
+        assert with_stats
+        # fake_small: 10 steps of 0.1*(t%3) + terminal 1.0.
+        expected = sum(0.1 * (t % 3) for t in range(1, 11)) + 1.0
+        np.testing.assert_allclose(
+            with_stats[-1]["episode_return"], expected, rtol=1e-4)
+        # episode_frames = agent steps x action repeats = the episode's
+        # 10 SIMULATOR steps (native repeats: 5 agent steps x 2).
+        assert with_stats[-1]["episode_frames"] == pytest.approx(10)
+        assert all("episodes_completed" not in r for r in rows)
+
     def test_ingraph_rejects_host_only_levels(self, tmp_path):
         config = small_config(tmp_path, train_backend="ingraph",
                               level_name="fake_tuple")
