@@ -78,7 +78,12 @@ def _probe_backend():
         "'kind': ds[0].device_kind, 'n': len(ds)}))"
     )
     last_err = None
+    backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF_S", "30"))
     for attempt in range(PROBE_ATTEMPTS):
+        if attempt:
+            # A hung tunnel sometimes recovers between claims; a short
+            # backoff gives the retry a different window.
+            time.sleep(backoff_s)
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],

@@ -47,6 +47,11 @@ class Config:
     height: int = 72
     benchmark_mode: bool = False
     num_env_workers_per_group: int = 8
+    # DMLab-only: psychlab dataset location and renderer backend
+    # (reference: experiment.py:77-87 dataset_path/renderer flags;
+    # software is the run-anywhere default, hardware needs EGL).
+    dataset_path: str = ""
+    renderer: str = "software"
 
     # -- eval (reference: experiment.py:57-58)
     test_num_episodes: int = 10

@@ -60,8 +60,12 @@ def env_kwargs(config: Config, name: Optional[str] = None) -> dict:
     etc. through create_environment, experiment.py:430-459)."""
     name = name or config.level_name
     if name.startswith(("fake_", "dmlab_")):
-        return {"height": config.height, "width": config.width,
-                "with_instruction": config.use_instruction}
+        kwargs = {"height": config.height, "width": config.width,
+                  "with_instruction": config.use_instruction}
+        if name.startswith("dmlab_"):
+            kwargs.update(dataset_path=config.dataset_path,
+                          renderer=config.renderer)
+        return kwargs
     if name.startswith(("atari_", "gym_", "doom_")):
         return {"height": config.height, "width": config.width}
     return {}
@@ -831,14 +835,16 @@ def test(config: Config) -> Dict[str, List[float]]:
     # from its persisted config so e.g. a no-instruction checkpoint
     # evaluates under --level_name=dmlab30 (whose env override would
     # otherwise grow an instruction tower the restore can't match).
+    # ONLY param-tree-shaping fields are adopted — execution knobs
+    # (core_impl/dtypes) restore fine either way and must stay CLI-
+    # controllable, e.g. evaluating a pallas-trained checkpoint with
+    # --core_impl=xla on a CPU-only host.
     saved_path = os.path.join(config.logdir, "config.json")
     if os.path.exists(saved_path):
         saved = Config.load(saved_path)
-        config = dataclasses.replace(config, **{
-            field: getattr(saved, field)
-            for field in ("torso_type", "use_instruction", "core_impl",
-                          "core_matmul_dtype", "compute_dtype")
-        })
+        config = dataclasses.replace(
+            config, torso_type=saved.torso_type,
+            use_instruction=saved.use_instruction)
     suite = config.level_name == "dmlab30"
     level_names = ([f"dmlab_{name}" for name in dmlab30.TEST_LEVELS]
                    if suite else [config.level_name])
