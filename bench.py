@@ -584,8 +584,26 @@ def bench_ingraph(diag, budget_s=90.0):
     num_actions, repeats = 9, 4
     frames_per_update = batch * unroll_len * repeats
 
+    # BENCH_INGRAPH_CORE_DTYPE=bfloat16 measures the mixed-precision
+    # Pallas LSTM end-to-end (default float32 = parity numerics).  The
+    # knob only exists on the pallas core — on an xla-core run the diag
+    # must record what actually executed, not the request.
+    core_impl = _core_impl()
+    core_dtype = os.environ.get("BENCH_INGRAPH_CORE_DTYPE", "float32")
+    if core_dtype not in ("float32", "bfloat16"):
+        diag["errors"].append(
+            f"BENCH_INGRAPH_CORE_DTYPE={core_dtype!r} invalid; "
+            f"using float32")
+        core_dtype = "float32"
+    if core_impl != "pallas" and core_dtype != "float32":
+        diag["errors"].append(
+            f"BENCH_INGRAPH_CORE_DTYPE={core_dtype} ignored: core "
+            f"resolved to {core_impl!r} which always runs float32")
+        core_dtype = "float32"
     agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16,
-                        core_impl=_core_impl())
+                        core_impl=core_impl,
+                        core_matmul_dtype=core_dtype)
+    diag["ingraph_core_matmul_dtype"] = core_dtype
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
                       frames_per_update=frames_per_update)

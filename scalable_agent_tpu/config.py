@@ -57,6 +57,10 @@ class Config:
     test_num_episodes: int = 10
     test_batch_size: int = 8  # parallel eval envs per level
     test_num_workers: int = 2  # env worker processes per eval fleet
+    # Record eval episodes (frames.npy + actions/rewards JSON per
+    # episode, one subdir per level/env slot) — the Sample-Factory
+    # record_to flag's role (reference: env_wrappers.py:433-497).
+    record_to: str = ""  # empty = no recording; test mode only
 
     # -- TPU-native knobs (no reference equivalent)
     torso_type: str = "shallow"  # shallow | resnet

@@ -771,6 +771,11 @@ def _eval_level(config: Config, agent: ImpalaAgent, params, step_fn,
             make_impala_stream, level_name,
             seed=config.seed * 977 + 131 * i,
             num_action_repeats=config.num_action_repeats,
+            # One directory per (level, env slot): parallel recorders
+            # must never interleave episode indices in one dir.
+            record_to=(os.path.join(config.record_to, level_name,
+                                    f"env_{i:02d}")
+                       if config.record_to else ""),
             **env_kwargs(config, level_name))
         for i in range(batch)
     ]
@@ -791,6 +796,12 @@ def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
         MultiAgentVectorEnv,
     )
 
+    if config.record_to:
+        # Per-player recording would need per-player directories threaded
+        # through the multiplayer factory; until then, ignoring the flag
+        # silently would be worse than saying so.
+        log.info("record_to is not supported for multi-agent eval; "
+                 "no recordings will be written")
     matches = max(1, config.test_batch_size // num_agents)
     if matches * num_agents != config.test_batch_size:
         # Eval batch is throughput sizing, not a correctness property

@@ -23,7 +23,8 @@ from scalable_agent_tpu.envs.worker import EnvProcess, RemoteEnvError
 
 def make_impala_stream(env_name: str, seed: int = 0,
                        benchmark_mode: bool = False,
-                       num_action_repeats: int = 1, **kwargs):
+                       num_action_repeats: int = 1,
+                       record_to: str = "", **kwargs):
     """Name -> seeded ImpalaStream; picklable via functools.partial.
 
     The one-stop factory the actor runtime and env workers use
@@ -49,6 +50,11 @@ def make_impala_stream(env_name: str, seed: int = 0,
                 f"cannot also request {num_action_repeats}")
         from scalable_agent_tpu.envs.wrappers import SkipFramesWrapper
         env = SkipFramesWrapper(env, num_action_repeats)
+    if record_to:
+        # Works for every family (the Doom pipeline can also record
+        # pre-wrapper frames via its own spec-level record_to).
+        from scalable_agent_tpu.envs.wrappers import RecordingWrapper
+        env = RecordingWrapper(env, record_to)
     stream = StreamAdapter(env)
     if benchmark_mode:
         stream = BenchmarkStream(stream, seed=seed)

@@ -320,11 +320,20 @@ class RecordingWrapper(Wrapper):
     def __init__(self, env: Environment, record_to: str):
         super().__init__(env)
         self._dir = record_to
-        self._episode = -1
+        os.makedirs(record_to, exist_ok=True)
+        # Continue numbering past any existing recordings: a respawned
+        # env worker re-runs this constructor on the same directory, and
+        # restarting at 0 would overwrite already-recorded episodes.
+        existing = [
+            int(name[len("episode_"):])
+            for name in os.listdir(record_to)
+            if name.startswith("episode_")
+            and name[len("episode_"):].isdigit()
+        ]
+        self._episode = max(existing, default=-1)
         self._frames = []
         self._actions = []
         self._rewards = []
-        os.makedirs(record_to, exist_ok=True)
 
     def _flush(self):
         if self._episode >= 0 and self._frames:

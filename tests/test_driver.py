@@ -160,6 +160,31 @@ class TestCoreImplCheckpointInterop:
 
 
 @pytest.mark.slow
+class TestEvalRecording:
+    def test_record_to_writes_episodes(self, tmp_path):
+        """--record_to in test mode writes frames.npy + episode.json
+        per completed episode, one dir per env slot (the SF record_to
+        flag's role, reference env_wrappers.py:433-497)."""
+        config = small_config(tmp_path)
+        run_train(config)
+        record_dir = str(tmp_path / "recordings")
+        test_config = small_config(tmp_path, mode="test",
+                                   record_to=record_dir,
+                                   test_num_episodes=2)
+        returns = run_test(test_config)
+        assert len(returns["fake_small"]) == 2
+        episodes = glob.glob(os.path.join(
+            record_dir, "fake_small", "env_*", "episode_*"))
+        assert episodes, record_dir
+        frames = np.load(os.path.join(episodes[0], "frames.npy"))
+        assert frames.ndim == 4 and frames.dtype == np.uint8
+        meta = json.load(open(os.path.join(episodes[0], "episode.json")))
+        # frames = initial + one per action.
+        assert len(meta["actions"]) == len(meta["rewards"])
+        assert frames.shape[0] == len(meta["actions"]) + 1
+
+
+@pytest.mark.slow
 class TestInGraphBackend:
     """--train_backend=ingraph: the fused rollout+update program as a
     CLI-reachable training mode with checkpoint/metrics/resume parity
