@@ -103,12 +103,15 @@ def model_parallel_shardings(mesh: Mesh, tree):
 
 def fused_kernels_profitable(mesh: Optional[Mesh] = None,
                              num_devices: Optional[int] = None) -> bool:
-    """THE policy behind every ``"auto"`` kernel choice (Learner
-    scan_impl, Config/driver core_impl, bench): the fused Pallas kernels
-    (ops/vtrace_pallas.py, ops/lstm_pallas.py) win only on a
+    """THE policy behind the ``"auto"`` LSTM-core choice (Config/driver
+    core_impl, bench): the fused Pallas LSTM core (ops/lstm_pallas.py,
+    1.6-2.2x over nn.scan on-chip — BENCH_NOTES r4) wins only on a
     single-device TPU mesh — ``pallas_call`` has no SPMD partitioning
     rule, so a multi-device mesh would replicate the call (correct but
-    wasteful), and non-TPU backends only have the interpreter.
+    wasteful), and non-TPU backends only have the interpreter.  (The
+    V-trace scan_impl="auto" no longer consults this: at production
+    shapes both V-trace impls are ~2-5 us, and the associative scan is
+    the shardable one, so auto always picks it.)
 
     Pass the actual ``mesh`` when one exists; ``num_devices`` when only
     the intended mesh size is known (e.g. from Config before the mesh is

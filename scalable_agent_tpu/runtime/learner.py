@@ -37,7 +37,6 @@ from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
 from scalable_agent_tpu.parallel.mesh import (
     batch_sharding,
-    fused_kernels_profitable,
     model_parallel_shardings,
     replicated_sharding,
 )
@@ -138,17 +137,19 @@ class Learner:
         self._mesh = mesh
         self._frames_per_update = float(frames_per_update)
         if scan_impl == "auto":
-            # The fused Pallas V-trace (ops/vtrace_pallas.py) measures
-            # 1.23x faster per learner update on a single v5e chip;
-            # the shared policy predicate decides where it wins.
-            # Explicit "pallas" forces it anywhere.  A seq axis > 1
-            # auto-selects the time-sharded recurrence
-            # (parallel/sequence.py — SURVEY §5.7 sequence parallelism).
+            # The associative scan is the auto choice everywhere: at
+            # production shapes V-trace is ~2-5 us on-chip either way
+            # (BENCH_NOTES r4 — earlier "1.23x pallas win" numbers were
+            # dispatch artifacts of the remote-TPU link), and only the
+            # associative form shards over data/seq axes.  Explicit
+            # "pallas" still forces the fused kernel (ops/
+            # vtrace_pallas.py).  A seq axis > 1 auto-selects the
+            # time-sharded recurrence (parallel/sequence.py — SURVEY
+            # §5.7 sequence parallelism).
             if mesh.shape.get("seq", 1) > 1:
                 scan_impl = "time_sharded"
             else:
-                scan_impl = ("pallas" if fused_kernels_profitable(mesh)
-                             else "associative")
+                scan_impl = "associative"
         if scan_impl == "time_sharded" and mesh.shape.get("seq", 1) == 1:
             # Degenerate seq axis: the shard_map would be pure overhead.
             scan_impl = "associative"
