@@ -41,7 +41,9 @@ PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
 # partial JSON line and exits if ANYTHING (main-process backend init,
 # compile, a wedged env worker) hangs — the probe alone can't guarantee
 # the one-line contract because the tunnel can also hang post-probe.
-TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "900"))
+# (r4 runs measured ~810-850s wall for the full stage list; 1200 leaves
+# headroom for the B=256 diagnostic without loosening the guarantee.)
+TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "1200"))
 
 # Peak bf16 matmul FLOP/s per chip, by jax device_kind prefix.
 _PEAK_FLOPS = [
@@ -246,8 +248,14 @@ def _timed_updates(update, state, traj, iters):
     return (time.perf_counter() - t0) / iters, state, metrics
 
 
-def bench_learner(result, diag):
-    """Steady-state jitted update at production shapes on one chip."""
+def _bench_learner_setup(batch, compile_diag):
+    """Shared construction for the learner stages (B=32 headline and
+    B=256 diagnostic — ONE code path so sync/compile/shape fixes can't
+    drift apart): agent/mesh/learner/example trajectory at the
+    reference production shapes (T=100, 72x96, 9 actions, 4 repeats),
+    AOT-compiled update, warmed with a real value fetch.  Returns
+    ``(update, state, traj, frames_per_update)``; compile_s /
+    flops_per_update land in ``compile_diag``."""
     import jax
     import jax.numpy as jnp
 
@@ -256,11 +264,10 @@ def bench_learner(result, diag):
     from scalable_agent_tpu.parallel import MeshSpec, make_mesh
     from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
 
-    unroll_len, batch, height, width = 100, 32, 72, 96
-    num_actions, repeats = 9, 4
+    unroll_len, height, width, num_actions, repeats = 100, 72, 96, 9, 4
     frames_per_update = batch * unroll_len * repeats
-
-    agent = ImpalaAgent(num_actions=num_actions, compute_dtype=jnp.bfloat16,
+    agent = ImpalaAgent(num_actions=num_actions,
+                        compute_dtype=jnp.bfloat16,
                         core_impl=_core_impl())
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
@@ -269,14 +276,20 @@ def bench_learner(result, diag):
         unroll_len, batch, height, width, num_actions)
     state = learner.init(jax.random.key(0), traj_host)
     traj = learner.put_trajectory(traj_host)
-
-    update = _compile_update(learner, state, traj, diag)
-
-    # Warm up with a real value fetch, then calibrate iteration count to
-    # the backend speed (a CPU-fallback update at production shapes can
-    # take tens of seconds — the bench must still finish and report).
+    update = _compile_update(learner, state, traj, compile_diag)
     state, metrics = update(state, traj)
     _fetch_scalar(metrics["total_loss"])
+    return update, state, traj, frames_per_update
+
+
+def bench_learner(result, diag):
+    """Steady-state jitted update at production shapes on one chip."""
+    update, state, traj, frames_per_update = _bench_learner_setup(
+        32, diag)
+
+    # Calibrate iteration count to the backend speed (a CPU-fallback
+    # update at production shapes can take tens of seconds — the bench
+    # must still finish and report).
     once, state, _ = _timed_updates(update, state, traj, 1)
     # ~15s per measurement run, capped so a slow CPU-fallback backend
     # (tens of seconds per update) still finishes inside the watchdog.
@@ -642,6 +655,46 @@ def bench_roofline(diag):
         diag["roofline_lstm_flops_frac"] = round(lstm_flops / total, 4)
 
 
+def bench_learner_b256(diag, budget_s=60.0):
+    """MXU-filling-batch diagnostic: the same jitted update at B=256
+    (8x the reference batch).  Not the headline — the parity config is
+    B=32 — but it answers the roofline batch-headroom question with a
+    measurement: if the B=32 mfu ceiling were batch starvation, the
+    identical program at B=256 would land materially higher mfu.
+    TPU only."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return
+    # Private compile record so compile_s/flops_per_update of the B=32
+    # headline aren't overwritten; errors still flow to the shared list.
+    sub = {"errors": diag["errors"]}
+    update, state, traj, frames_per_update = _bench_learner_setup(
+        256, sub)
+    if "compile_s" in sub:
+        diag["learner_b256_compile_s"] = sub["compile_s"]
+    once, state, _ = _timed_updates(update, state, traj, 1)
+    iters = max(5, min(100, int(budget_s / 2.0 / max(once, 1e-4))))
+    dt, state, _ = _timed_updates(update, state, traj, iters)
+    diag["learner_b256_sec_per_update"] = round(dt, 6)
+    diag["learner_b256_iters"] = iters
+    fps = round(frames_per_update / dt, 1)
+    flops = sub.get("flops_per_update")
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    if flops:
+        diag["learner_b256_flops_per_update"] = flops
+        if peak:
+            mfu = flops / dt / peak
+            diag["learner_b256_mfu"] = round(mfu, 4)
+            if mfu > 1.0:
+                # Same impossible-sync guard as the headline stage.
+                diag["errors"].append(
+                    f"IMPOSSIBLE learner_b256 mfu {mfu:.2f} > 1.0: "
+                    f"synchronization failed; fps value zeroed")
+                fps = 0.0
+    diag["learner_b256_env_frames_per_sec"] = fps
+
+
 def bench_ingraph(diag, budget_s=90.0):
     """End-to-end fps of the fused in-graph path: rollout + update as one
     jitted program over the on-device benchmark env (runtime/ingraph.py).
@@ -829,6 +882,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_roofline failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "bench_learner_b256"
+    try:
+        bench_learner_b256(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_learner_b256 failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()
 
