@@ -41,9 +41,10 @@ PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
 # partial JSON line and exits if ANYTHING (main-process backend init,
 # compile, a wedged env worker) hangs — the probe alone can't guarantee
 # the one-line contract because the tunnel can also hang post-probe.
-# (r4 runs measured ~810-850s wall for the full stage list; 1200 leaves
-# headroom for the B=256 diagnostic without loosening the guarantee.)
-TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "1200"))
+# (r4 full runs measured ~990s wall with the 420s e2e budget and the
+# B=256 diagnostic; 1400 leaves slow-window headroom without
+# loosening the guarantee.)
+TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "1400"))
 
 # Peak bf16 matmul FLOP/s per chip, by jax device_kind prefix.
 _PEAK_FLOPS = [
@@ -871,7 +872,12 @@ def main():
     try:
         bench_end_to_end(
             result, diag,
-            budget_s=240.0 if diag["platform"] != "cpu" else 15.0,
+            # 240s repeatedly landed 7-22 updates on the degraded r4
+            # link — below the 30-update floor; 420s reached it at the
+            # mid-range observed rates (run 8: exactly 30).  A
+            # worst-case window (run 4's 2.7k fps) would still fall
+            # short — the floor error then records that honestly.
+            budget_s=420.0 if diag["platform"] != "cpu" else 15.0,
             platform=diag["platform"])
     except Exception:
         diag["errors"].append(
