@@ -445,7 +445,12 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
     # +1-lag overlap), while fused mode needs num_groups — it emits all
     # k trajectories at once, and a smaller queue would stall the
     # lockstep driver mid-handoff and lose its learner overlap.
-    fused_shards = int(os.environ.get("BENCH_E2E_SHARDS", "1"))
+    # 2 shards measured 14.4k fps where 1 measured 8-9.3k on
+    # comparable links (r4 sweep: one shard's upload+env overlaps the
+    # other's action-fetch RTT, reaching ~80% of the pure-bandwidth
+    # ceiling); 3 shards regressed to 12.6k (uneven 2/2/1 group split
+    # + host thread contention on one core).
+    fused_shards = int(os.environ.get("BENCH_E2E_SHARDS", "2"))
     if inference_mode == "accum_fused":
         diag["e2e_config"]["fused_shards"] = fused_shards
     pool = ActorPool(agent, groups, unroll_len,
@@ -787,11 +792,16 @@ def bench_ingraph(diag, budget_s=90.0):
         # low (an r3-class window would land ~5% fetch share instead
         # of the <4% target).  bench_link has already measured the
         # RTT by the time this stage runs — subtract it.
+        # If bench_link failed, there is no RTT to subtract — record
+        # that the calibration ran uncorrected instead of silently
+        # reintroducing the rtt/chunk bias.
         rtt_s = diag.get("link_rtt_ms", 0.0) / 1e3
         per_update = max(
             (time.perf_counter() - t_cal - rtt_s) / chunk, 1e-4)
         counter += chunk
         chunk = max(10, min(400, int(2.0 / per_update)))
+        diag["ingraph_fetch_chunk"] = chunk
+        diag["ingraph_chunk_rtt_corrected"] = "link_rtt_ms" in diag
     t0 = time.perf_counter()
     loss = float("nan")
     while (updates < 30 or time.perf_counter() - t0 < 10.0):
