@@ -71,6 +71,7 @@ class DeviceFakeEnv:
         episode_length: int = 10,
         length_jitter: int = 0,
         num_action_repeats: int = 1,
+        reward_mode: str = "schedule",
     ):
         self.height = height
         self.width = width
@@ -79,6 +80,9 @@ class DeviceFakeEnv:
         self.episode_length = episode_length
         self.length_jitter = length_jitter
         self.num_action_repeats = max(1, int(num_action_repeats))
+        if reward_mode not in ("schedule", "bandit", "memory"):
+            raise ValueError(f"unknown reward_mode {reward_mode!r}")
+        self.reward_mode = reward_mode
         self.action_space = Discrete(num_actions)
         self.observation_spec = Observation(
             frame=TensorSpec((height, width, channels), np.uint8, "frame"),
@@ -99,13 +103,31 @@ class DeviceFakeEnv:
         mix = ((seed * 1000003) % m + (episode % m) * (7919 % m)) % m
         return self.episode_length + mix
 
+    def _cue(self, seed, episode, step):
+        """Rewarded action index, [B] i32 — term-by-term mod of the
+        host's ``(seed*131 + episode*29 [+ step*13]) % A`` (FakeEnv._cue,
+        envs/fake.py): exact vs the host bigints, int32-overflow-free."""
+        a = self.num_actions
+        mix = (seed * 131) % a + (episode % a) * (29 % a)
+        if self.reward_mode == "bandit":
+            mix = mix + (step % a) * (13 % a)
+        return mix % a
+
     def _frame(self, seed, episode, step, action):
         """uint8 [B, H, W, C]: constant base with 3 encoded pixels
         (FakeEnv._frame, envs/fake.py).  Same term-by-term mod-251
         arithmetic: exact vs the host bigints, overflow-free for any
-        episode/step count."""
-        base = (((seed * 131) % 251 + (episode % 251) * 17
-                 + (step % 251) * 7) % 251).astype(jnp.uint8)
+        episode/step count.  Bandit/memory modes fill with the scaled
+        cue instead (FakeEnv._fill_value)."""
+        if self.reward_mode == "schedule":
+            base = ((seed * 131) % 251 + (episode % 251) * 17
+                    + (step % 251) * 7) % 251
+        else:
+            scale = 255 // max(1, self.num_actions - 1)
+            base = self._cue(seed, episode, step) * scale
+            if self.reward_mode == "memory":
+                base = jnp.where(step == 0, base, 128)
+        base = base.astype(jnp.uint8)
         b = base.shape[0]
         frame = jnp.broadcast_to(
             base[:, None, None, None],
@@ -166,11 +188,18 @@ class DeviceFakeEnv:
         done = jnp.zeros_like(step, dtype=bool)
         for _ in range(self.num_action_repeats):
             active = ~done
+            if self.reward_mode != "schedule":
+                # Pre-increment cue: the one visible in the observation
+                # the agent acted on (FakeEnv.step, envs/fake.py).
+                cue = self._cue(state.seed, state.episode, step)
+                reward = reward + jnp.where(
+                    active & (action == cue), 1.0, 0.0)
             step = step + active.astype(jnp.int32)
             sub_done = active & (step >= ep_len)
-            reward = reward + jnp.where(
-                active, 0.1 * (step % 3).astype(jnp.float32), 0.0)
-            reward = reward + jnp.where(sub_done, 1.0, 0.0)
+            if self.reward_mode == "schedule":
+                reward = reward + jnp.where(
+                    active, 0.1 * (step % 3).astype(jnp.float32), 0.0)
+                reward = reward + jnp.where(sub_done, 1.0, 0.0)
             done = done | sub_done
 
         # Emitted info includes the final step; carried state resets on
@@ -204,7 +233,7 @@ class DeviceFakeEnv:
 
 
 def make_device_env(level_name: str, height: int = 0, width: int = 0,
-                    num_actions: int = 9, num_action_repeats: int = 1,
+                    num_actions: int = 0, num_action_repeats: int = 1,
                     with_instruction: bool = False,
                     **kwargs) -> DeviceFakeEnv:
     """Device-env factory for levels expressible as pure XLA functions
@@ -221,8 +250,14 @@ def make_device_env(level_name: str, height: int = 0, width: int = 0,
         raise ValueError(
             "device envs do not emit instruction observations")
     defaults = {
-        "fake_benchmark": dict(height=72, width=96, episode_length=1000),
-        "fake_small": dict(height=16, width=16, episode_length=10),
+        "fake_benchmark": dict(height=72, width=96, episode_length=1000,
+                               num_actions=9),
+        "fake_small": dict(height=16, width=16, episode_length=10,
+                           num_actions=9),
+        "fake_bandit": dict(height=16, width=16, episode_length=16,
+                            num_actions=4, reward_mode="bandit"),
+        "fake_memory": dict(height=16, width=16, episode_length=8,
+                            num_actions=4, reward_mode="memory"),
     }
     if level_name not in defaults:
         raise ValueError(
@@ -234,6 +269,7 @@ def make_device_env(level_name: str, height: int = 0, width: int = 0,
         params["height"] = height
     if width:
         params["width"] = width
+    if num_actions:  # 0 = use the level's host-registry default
+        params["num_actions"] = num_actions
     params.update(kwargs)
-    return DeviceFakeEnv(num_actions=num_actions,
-                         num_action_repeats=num_action_repeats, **params)
+    return DeviceFakeEnv(num_action_repeats=num_action_repeats, **params)

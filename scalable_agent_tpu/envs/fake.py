@@ -29,12 +29,30 @@ from scalable_agent_tpu.types import Observation
 class FakeEnv(Environment):
     """Deterministic episodic environment.
 
-    Reward at step t (1-based) is ``0.1 * (t % 3)``; the terminal step adds
-    +1.  Episode length = ``episode_length`` (+ per-episode deterministic
+    Three reward modes:
+
+    - ``"schedule"`` (default): reward at step t (1-based) is
+      ``0.1 * (t % 3)``; the terminal step adds +1.  Rewards ignore the
+      action — deterministic golden data, NOT learnable.
+    - ``"bandit"``: a contextual bandit.  Every frame is filled with a
+      per-step cue value; the action matching the cue earns +1, others 0.
+      A uniform-random policy earns ``episode_length / num_actions`` per
+      episode, the optimal policy ``episode_length`` — the gap is the
+      learning signal the end-to-end learning tests (tests/
+      test_learning.py) assert on, standing in for the reference's
+      published learning curves (reference: README.md:36-44).
+    - ``"memory"``: like bandit, but the cue is fixed per episode and
+      shown ONLY in the episode's first frame (later frames are blank
+      mid-gray).  Solving it requires the LSTM to latch the cue across
+      the episode — and a broken done-reset leaks the previous episode's
+      latched cue, so learning collapses toward chance; this is the
+      red-test for the core's done-reset semantics.
+
+    Episode length = ``episode_length`` (+ per-episode deterministic
     jitter of 0..length_jitter).  Frames are uint8 [H, W, C] with
     pixel[0,0,0] = episode index % 256, pixel[0,1,0] = step index % 256,
-    pixel[0,2,0] = last action % 256, and the rest a cheap deterministic
-    pattern.
+    pixel[0,2,0] = last action % 256, and the rest mode-dependent
+    (deterministic pattern / cue fill).
     """
 
     def __init__(
@@ -50,6 +68,7 @@ class FakeEnv(Environment):
         instruction_len: int = 16,
         action_space: Optional[Space] = None,
         num_action_repeats: int = 1,
+        reward_mode: str = "schedule",
     ):
         self._h, self._w, self._c = height, width, channels
         # Native action repeats, like DMLab's ``num_steps`` (reference:
@@ -61,6 +80,21 @@ class FakeEnv(Environment):
         # Composite spaces (TupleSpace) exercise the tuple-distribution
         # path hermetically (reference tests need real Doom for this).
         self.action_space = action_space or Discrete(num_actions)
+        if reward_mode not in ("schedule", "bandit", "memory"):
+            raise ValueError(f"unknown reward_mode {reward_mode!r}")
+        if reward_mode != "schedule" and not isinstance(
+                self.action_space, Discrete):
+            raise ValueError(
+                f"reward_mode {reward_mode!r} needs a Discrete action "
+                f"space (the cue is an action index)")
+        self._reward_mode = reward_mode
+        # Cues index the ACTUAL action space: a caller passing an
+        # explicit Discrete(n) must get reachable cues (and the
+        # documented random floor episode_length/n), regardless of the
+        # num_actions arg.
+        self._num_actions = (self.action_space.n
+                             if isinstance(self.action_space, Discrete)
+                             else num_actions)
         self._episode_length = episode_length
         self._length_jitter = length_jitter
         self._seed = seed
@@ -87,8 +121,28 @@ class FakeEnv(Environment):
             self._length_jitter + 1)
         return self._episode_length + mix
 
+    def _cue(self, step: int) -> int:
+        """The rewarded action for (seed, episode, step).  Plain modular
+        arithmetic so the device mirror (envs/device.py) reproduces it
+        exactly in int32.  Memory mode drops the step term: one cue per
+        episode."""
+        mix = self._seed * 131 + self._episode * 29
+        if self._reward_mode == "bandit":
+            mix += step * 13
+        return mix % self._num_actions
+
+    def _fill_value(self) -> int:
+        """The frame's fill byte: the mode's learning signal."""
+        if self._reward_mode == "schedule":
+            return (self._seed * 131 + self._episode * 17
+                    + self._step * 7) % 251
+        scale = 255 // max(1, self._num_actions - 1)
+        if self._reward_mode == "memory" and self._step != 0:
+            return 128  # cue hidden after the first frame
+        return self._cue(self._step) * scale
+
     def _frame(self, action: int) -> np.ndarray:
-        base = (self._seed * 131 + self._episode * 17 + self._step * 7) % 251
+        base = self._fill_value()
         frame = np.full((self._h, self._w, self._c), base, dtype=np.uint8)
         frame[0, 0, 0] = self._episode % 256
         frame[0, 1, 0] = self._step % 256
@@ -121,9 +175,15 @@ class FakeEnv(Environment):
         done = False
         episode_len = self._episode_len()
         for _ in range(self.native_action_repeats):
+            # Bandit/memory: the cue the agent SAW is the pre-increment
+            # state's (the observation emitted before this call), so
+            # reward is computed before advancing.
+            if self._reward_mode != "schedule":
+                reward += 1.0 if action == self._cue(self._step) else 0.0
             self._step += 1
             done = self._step >= episode_len
-            reward += 0.1 * (self._step % 3) + (1.0 if done else 0.0)
+            if self._reward_mode == "schedule":
+                reward += 0.1 * (self._step % 3) + (1.0 if done else 0.0)
             if done:
                 break
         return self._observation(action), np.float32(reward), done, {}

@@ -60,6 +60,22 @@ def _make_fake(full_env_name: str, **kwargs) -> Environment:
         kwargs.setdefault("height", 16)
         kwargs.setdefault("width", 16)
         kwargs.setdefault("episode_length", 10)
+    elif full_env_name == "fake_bandit":
+        # Learnable contextual bandit (envs/fake.py reward_mode docs):
+        # the end-to-end learning-proof level.
+        kwargs.setdefault("height", 16)
+        kwargs.setdefault("width", 16)
+        kwargs.setdefault("episode_length", 16)
+        kwargs.setdefault("num_actions", 4)
+        kwargs.setdefault("reward_mode", "bandit")
+    elif full_env_name == "fake_memory":
+        # Cue shown only in the first frame: requires LSTM memory and a
+        # correct done-reset (envs/fake.py reward_mode docs).
+        kwargs.setdefault("height", 16)
+        kwargs.setdefault("width", 16)
+        kwargs.setdefault("episode_length", 8)
+        kwargs.setdefault("num_actions", 4)
+        kwargs.setdefault("reward_mode", "memory")
     elif full_env_name == "fake_tuple":
         # Composite action space: Tuple(Discrete, Discretized) — the
         # hermetic stand-in for Doom's composite spaces

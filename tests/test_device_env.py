@@ -24,25 +24,35 @@ H = W = 12
 NUM_ACTIONS = 4
 
 
-def host_streams(seeds, episode_length, jitter, repeats):
+def host_streams(seeds, episode_length, jitter, repeats,
+                 reward_mode="schedule"):
     streams = []
     for s in seeds:
         env = FakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
                       episode_length=episode_length, length_jitter=jitter,
-                      seed=s, num_action_repeats=repeats)
+                      seed=s, num_action_repeats=repeats,
+                      reward_mode=reward_mode)
         streams.append(ImpalaStream(StreamAdapter(env)))
     return streams
 
 
-@pytest.mark.parametrize("repeats,jitter", [(1, 0), (4, 0), (4, 3)])
-def test_device_env_mirrors_host_stack(repeats, jitter):
+@pytest.mark.parametrize("repeats,jitter,reward_mode", [
+    (1, 0, "schedule"), (4, 0, "schedule"), (4, 3, "schedule"),
+    # Learnable modes (tests/test_learning.py) must mirror exactly too:
+    # the ingraph learning proof is only as real as this equivalence.
+    (1, 0, "bandit"), (3, 0, "bandit"),
+    (1, 0, "memory"), (3, 0, "memory"),
+])
+def test_device_env_mirrors_host_stack(repeats, jitter, reward_mode):
     seeds = [0, 3, 11]
     episode_length = 5
     dev = DeviceFakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
                         episode_length=episode_length,
                         length_jitter=jitter,
-                        num_action_repeats=repeats)
-    streams = host_streams(seeds, episode_length, jitter, repeats)
+                        num_action_repeats=repeats,
+                        reward_mode=reward_mode)
+    streams = host_streams(seeds, episode_length, jitter, repeats,
+                           reward_mode)
     state, out = dev.initial(np.asarray(seeds, np.int32))
     host_outs = [s.initial() for s in streams]
     step = jax.jit(dev.step)
