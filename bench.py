@@ -180,11 +180,18 @@ def _timed_us_pipelined(fn, args, iters=50):
       instead of tens-of-MB HLO constants lowered per stage.
 
     The per-window link overhead (one dispatch+fetch round trip) is
-    measured on a trivial program with the same window mechanism and
-    subtracted — otherwise RTT/iters (~1.3 ms at 67 ms RTT over 50
-    iters) masquerades as per-call cost — and both the overhead and
-    the stage take the min of 3 windows, since any single window
-    samples link weather as much as the kernel.
+    measured on a trivial program taking the SAME argument tree — so
+    its dispatch serializes the same arg handles as the real program —
+    and subtracted: otherwise RTT/iters (~1.3 ms at 67 ms RTT over 50
+    iters) masquerades as per-call cost.  Both the overhead and the
+    stage take the min of 3 windows, since any single window samples
+    link weather as much as the kernel.
+
+    Returns ``(us_per_call, floor_us)``: ``floor_us`` is the spread of
+    the overhead windows divided by ``iters`` — the measurement's own
+    resolution.  Readings below it are bounded, not measured; callers
+    should clamp to the floor rather than publish e.g. "0.0 us"
+    (round-4 artifact: ``kernel_vtrace_associative_us: 0.0``).
     """
     import jax
     import jax.numpy as jnp
@@ -200,18 +207,26 @@ def _timed_us_pipelined(fn, args, iters=50):
         return x
 
     def _live_sum(out):
+        # EVERY output leaf feeds the carry — integer/bool leaves
+        # included (a stage whose compute fed only argmax actions or
+        # counters would otherwise be DCE'd wholesale).
         total = jnp.float32(0)
         for leaf in jax.tree_util.tree_leaves(out):
             leaf = jnp.asarray(leaf)
-            if jnp.issubdtype(leaf.dtype, jnp.inexact):
-                total = total + leaf.sum().astype(jnp.float32)
+            total = total + leaf.sum().astype(jnp.float32)
         return total
 
     def prog_fn(c0, *a):
         def body(carry, _):
             seeded = jax.tree_util.tree_map(
                 lambda x: _perturb(x, carry), a)
-            return _live_sum(fn(*seeded)), None
+            total = _live_sum(fn(*seeded))
+            # The perturbation contract assumes the carry is finite
+            # (carry != carry must be runtime-False): if a timed stage
+            # overflows (bf16 loss, random-init grads), reset to 0
+            # instead of silently flipping every int/bool perturbation
+            # into a value change.
+            return jnp.where(jnp.isfinite(total), total, 0.0), None
 
         return jax.lax.scan(body, c0, None, length=iters)[0]
 
@@ -227,14 +242,44 @@ def _timed_us_pipelined(fn, args, iters=50):
     # 67-91 ms RTT one window over 50 iters would carry a +1.3-1.8 ms
     # PER-CALL bias — the same magnitude as the kernels being
     # measured.  Subtract the per-window link overhead, measured with
-    # the same window mechanism on a trivial program, and take the min
-    # of 3 windows of each (RTT jitter makes any single window a
-    # point-sample of link weather, not of the kernel).
-    tiny = jax.jit(lambda x: x + 1.0)
-    _fetch_scalar(tiny(jnp.float32(0)))
-    overhead_s = min(window(tiny, jnp.float32(1)) for _ in range(3))
+    # the same window mechanism on a same-arg-tree trivial program
+    # (one elementwise traversal of the args, so its dispatch cost —
+    # arg-handle serialization included — matches what is subtracted),
+    # and take the min of 3 windows of each (RTT jitter makes any
+    # single window a point-sample of link weather, not of the
+    # kernel).
+    tiny = jax.jit(lambda c, *a: c + _live_sum(a))
+    _fetch_scalar(tiny(jnp.float32(0), *args))
+    overhead_windows = sorted(window(tiny, jnp.float32(1), *args)
+                              for _ in range(3))
+    overhead_s = overhead_windows[0]
+    # Resolution of the min-of-3 estimator: the gap between the two
+    # BEST overhead windows (the max-min spread would let one RTT
+    # spike in the worst window inflate the floor 10-40x above real
+    # kernel times).
+    floor_us = (overhead_windows[1] - overhead_windows[0]) / iters * 1e6
     total_s = min(window(prog, jnp.float32(0), *args) for _ in range(3))
-    return max(0.0, total_s - overhead_s) / iters * 1e6
+    return max(0.0, total_s - overhead_s) / iters * 1e6, floor_us
+
+
+def _record_timed(diag, key, fn, args, iters):
+    """Publish a pipelined micro-timing under ``key``.  A reading at or
+    below the window's own resolution is a bound, not a measurement:
+    0.0 is replaced by the floor, and any sub-floor reading carries an
+    explicit note (round-4 artifact: ``kernel_vtrace_associative_us:
+    0.0`` printed as if measured)."""
+    us, floor_us = _timed_us_pipelined(fn, args, iters=iters)
+    if us <= 0.0:
+        diag[key] = round(max(floor_us, 0.01), 2)
+        diag[key + "_note"] = (
+            f"below timer resolution (~{floor_us:.2f} us window "
+            f"spread); reported as the floor, not a measurement")
+    else:
+        diag[key] = round(us, 2)
+        if us < floor_us:
+            diag[key + "_note"] = (
+                f"below timer resolution (~{floor_us:.2f} us window "
+                f"spread): bounded, not precise")
 
 
 def _timed_updates(update, state, traj, iters):
@@ -539,8 +584,8 @@ def bench_kernels(diag):
     for impl in ("associative", "pallas"):
         fn = functools.partial(
             vtrace.from_importance_weights, scan_impl=impl)
-        diag[f"kernel_vtrace_{impl}_us"] = round(
-            _timed_us_pipelined(fn, vt_args, iters=200), 1)
+        _record_timed(diag, f"kernel_vtrace_{impl}_us", fn, vt_args,
+                      iters=200)
 
     def xla_unroll(x, done, c0, h0, wi, wh, b):
         # stop_gradient matches the Pallas kernel's zero done-cotangent,
@@ -584,9 +629,8 @@ def bench_kernels(diag):
         for name, unroll in variants:
             vg = jax.value_and_grad(
                 lambda a, u=unroll: jnp.sum(u(*a)[0] ** 2))
-            diag[f"kernel_lstm_grad_{name}{suffix}_us"] = round(
-                _timed_us_pipelined(lambda *a: vg(a), args,
-                                    iters=200), 1)
+            _record_timed(diag, f"kernel_lstm_grad_{name}{suffix}_us",
+                          lambda *a: vg(a), args, iters=200)
 
 
 def bench_roofline(diag):
@@ -627,26 +671,24 @@ def bench_roofline(diag):
     # live) — with independent dispatches the axon tunnel's per-call
     # overhead made "optimizer alone" read slower than the whole
     # chained update, an obvious self-contradiction.
-    timed_us = lambda fn, args: round(
-        _timed_us_pipelined(fn, args, iters=30), 1)
-
     fwd = lambda p, t: agent.apply(
         p, t.agent_outputs.action, t.env_outputs, t.agent_state)
-    diag["roofline_forward_unroll_us"] = timed_us(
-        fwd, (state.params, traj))
+    _record_timed(diag, "roofline_forward_unroll_us", fwd,
+                  (state.params, traj), iters=30)
 
     loss_fn = lambda p, t: learner._loss(p, t)[0]
-    diag["roofline_loss_forward_us"] = timed_us(
-        loss_fn, (state.params, traj))
+    _record_timed(diag, "roofline_loss_forward_us", loss_fn,
+                  (state.params, traj), iters=30)
 
     grad_fn = lambda p, t: jax.grad(
         lambda q: learner._loss(q, t)[0])(p)
     grads = jax.jit(grad_fn)(state.params, traj)
-    diag["roofline_loss_grad_us"] = timed_us(
-        grad_fn, (state.params, traj))
+    _record_timed(diag, "roofline_loss_grad_us", grad_fn,
+                  (state.params, traj), iters=30)
 
     opt_fn = lambda g, s: learner._tx.update(g, s.opt_state, s.params)
-    diag["roofline_optimizer_us"] = timed_us(opt_fn, (grads, state))
+    _record_timed(diag, "roofline_optimizer_us", opt_fn, (grads, state),
+                  iters=30)
 
     # Analytic LSTM matmul share of the XLA-counted update FLOPs:
     # fwd = T*B*2*(D*4H + H*4H); backward ~2x (dgates@W^T pair +
@@ -819,6 +861,231 @@ def bench_ingraph(diag, budget_s=90.0):
     diag["ingraph_vs_baseline"] = round(
         updates * frames_per_update / dt / BASELINE_FPS, 3)
     diag["ingraph_final_loss"] = round(loss, 3)
+    # The loss is a SUM over T*B timesteps (reference parity,
+    # ops/losses.py) — the r4 "96k" reading is ~30/step: dominated by
+    # 0.5 * baseline_cost * (vs - V)^2 with ~10-scale discounted-return
+    # targets (clipped reward ~0.1/step at discount 0.99) against a
+    # near-init baseline.  fake_benchmark's rewards ignore actions, so
+    # no policy can reduce the return variance the baseline must fit —
+    # the per-step magnitude is expected to stay O(10), not fall to 0;
+    # LEARNING is proven separately on fake_bandit (bench_learning).
+    diag["ingraph_final_loss_per_step"] = round(
+        loss / (unroll_len * batch), 3)
+
+
+def bench_learning(diag, budget_s=120.0):
+    """Learning proof on the real backend: the fused in-graph trainer on
+    ``fake_bandit`` (envs/fake.py reward_mode docs — uniform-random
+    return 4.0, optimal 16.0) for >= 50 updates, recording the return
+    curve and a pass/fail ``learning_improved`` verdict.  The CPU twin
+    of this run is asserted in tests/test_learning.py; this stage puts
+    the same evidence in every round's bench artifact, on the chip
+    (the role of the reference's published learning curves,
+    reference: README.md:36-44).
+
+    Parity numerics on purpose (float32 torso, xla core): this stage
+    proves optimization works end-to-end, not speed — the perf stages
+    above measure the fast configuration."""
+    import jax
+    import numpy as np
+
+    from scalable_agent_tpu.envs.device import make_device_env
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import (
+        InGraphTrainer, Learner, LearnerHyperparams)
+
+    t_start = time.perf_counter()
+    unroll_len, batch, total_updates, chunk = 16, 32, 150, 25
+    random_return, target_return = 4.0, 8.0  # floor, 2x floor
+    env = make_device_env("fake_bandit")
+    agent = ImpalaAgent(num_actions=env.num_actions)
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    hp = LearnerHyperparams(
+        total_environment_frames=float(total_updates * unroll_len * batch),
+        learning_rate=0.002, entropy_cost=0.003)
+    learner = Learner(agent, hp, mesh,
+                      frames_per_update=unroll_len * batch)
+    trainer = InGraphTrainer(agent, learner, env, unroll_len, batch,
+                             seed=3)
+    state, carry = trainer.init(jax.random.key(0))
+    curve = []
+    done = 0
+    while done < total_updates:
+        state, carry, metrics = trainer.run(
+            state, carry, chunk, counter_start=done)
+        done += chunk
+        # Value-fetch sync (block_until_ready lies on the axon tunnel).
+        curve.append([done, round(
+            float(np.asarray(metrics["episode_return"])), 2)])
+        if time.perf_counter() - t_start > budget_s:
+            diag["errors"].append(
+                f"learning stage hit its {budget_s:.0f}s budget at "
+                f"update {done}/{total_updates}")
+            break
+    diag["learning_curve"] = curve  # [[update, mean episode return]]
+    diag["learning_random_return"] = random_return
+    diag["learning_optimal_return"] = 16.0
+    final = float(np.mean([r for _, r in curve[-2:]]))
+    diag["learning_final_return"] = round(final, 2)
+    improved = (done >= 50 and final >= target_return
+                and final > curve[0][1] + 1.0)
+    diag["learning_improved"] = bool(improved)
+    if not improved:
+        diag["errors"].append(
+            f"learning verdict FAILED: final return {final:.2f} "
+            f"(random {random_return}, target >= {target_return}, "
+            f"first window {curve[0][1] if curve else 'n/a'}, "
+            f"{done} updates)")
+
+
+E2E_RETRY_BW_THRESHOLD_MB_S = float(
+    os.environ.get("BENCH_E2E_RETRY_BW_MB_S", "300"))
+
+
+def _probe_h2d_mb_s():
+    """One-shot H2D bandwidth probe: one 16 MB upload synchronized by a
+    value fetch (~1 RTT included, so a slight under-estimate — the
+    honest direction for a go/no-go gate)."""
+    import jax
+    import numpy as np
+
+    d = jax.devices()[0]
+    big = np.zeros((16 << 20,), np.uint8)
+    t0 = time.perf_counter()
+    float(np.asarray(jax.device_put(big, d)[0]))
+    return 16.0 / (time.perf_counter() - t0)
+
+
+def maybe_retry_e2e(diag, start_monotonic, deadline):
+    """Link-gated e2e retry (round-4 VERDICT item 2): the e2e number is
+    a host-link measurement, and the first window may have sampled a
+    collapsed tunnel (r4: 24-104 MB/s vs r3's 0.6-1 GB/s).  Probe the
+    link until either a window clears E2E_RETRY_BW_THRESHOLD_MB_S —
+    then re-run ONLY the e2e stage — or the watchdog budget runs out.
+    Every probe is logged so "bandwidth never recovered" is on record
+    when no retry fires."""
+    if diag.get("platform") != "tpu":
+        return
+    if diag.get("e2e_vs_baseline", 0.0) >= 1.0:
+        return
+    probes = diag.setdefault("e2e_link_probes", [])
+    min_retry_s = 150.0  # smallest e2e budget worth spending
+    margin_s = 120.0  # stay clear of the watchdog
+    cleared = False
+    while True:
+        left = deadline - time.monotonic()
+        if left < min_retry_s + margin_s:
+            break
+        try:
+            mb_s = _probe_h2d_mb_s()
+        except Exception:
+            diag["errors"].append(
+                "e2e link probe failed: " + traceback.format_exc(limit=1))
+            return
+        probes.append({
+            "at_s": round(time.monotonic() - start_monotonic, 0),
+            "h2d_mb_s": round(mb_s, 0)})
+        if mb_s >= E2E_RETRY_BW_THRESHOLD_MB_S:
+            cleared = True
+            break
+        time.sleep(min(30.0, max(
+            1.0, deadline - time.monotonic() - min_retry_s - margin_s)))
+    if not cleared:
+        diag["e2e_retry_verdict"] = (
+            f"no probe reached {E2E_RETRY_BW_THRESHOLD_MB_S:.0f} MB/s "
+            f"before the watchdog budget; e2e number stands as a "
+            f"degraded-link measurement")
+        return
+    first = {k: diag.get(k) for k in (
+        "e2e_env_frames_per_sec", "e2e_updates_measured",
+        "e2e_vs_baseline")}
+    sub = {"errors": diag["errors"]}
+    budget = min(420.0, deadline - time.monotonic() - margin_s)
+    diag["e2e_retry_budget_s"] = round(budget, 0)
+    try:
+        # bench_end_to_end's result arg is unused by the e2e stage (it
+        # writes diag keys); pass a throwaway.
+        bench_end_to_end({}, sub, budget_s=budget, platform="tpu")
+    except Exception:
+        diag["errors"].append(
+            "e2e retry failed: " + traceback.format_exc(limit=3))
+        return
+    retry_fps = sub.get("e2e_env_frames_per_sec", 0.0)
+    if retry_fps and retry_fps > (first["e2e_env_frames_per_sec"] or 0.0):
+        # The retry IS the headline e2e (measured on the healthier
+        # link); the degraded first attempt stays on record.
+        diag["e2e_first_attempt"] = first
+        for k in ("e2e_env_frames_per_sec", "e2e_updates_measured",
+                  "e2e_vs_baseline"):
+            diag[k] = sub[k]
+        diag["e2e_retry_verdict"] = "retry promoted to headline"
+    else:
+        diag["e2e_retry"] = {k: sub.get(k) for k in (
+            "e2e_env_frames_per_sec", "e2e_updates_measured",
+            "e2e_vs_baseline")}
+        diag["e2e_retry_verdict"] = (
+            "retry did not beat the first attempt")
+
+
+def regression_guard(result, diag):
+    """Compare this run's chip-bound headline metrics against the
+    newest committed BENCH_r*.json: a silent perf regression should
+    fail the bench loudly (round-4 VERDICT item 7).  The e2e number is
+    exempt — it measures link weather, not the framework."""
+    import glob
+
+    files = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    if not files:
+        return
+    path = files[-1]
+    try:
+        raw = json.load(open(path))
+    except Exception:
+        diag["errors"].append(
+            f"regression guard: unreadable {os.path.basename(path)}")
+        return
+    prev = raw if isinstance(raw, dict) and "metric" in raw else None
+    if prev is None and isinstance(raw, dict) and "tail" in raw:
+        # Driver artifact format: the bench JSON line is inside `tail`.
+        for line in reversed(str(raw["tail"]).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in cand:
+                    prev = cand
+                    break
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return  # nothing comparable (e.g. this run fell back to CPU)
+    diag["regression_reference"] = os.path.basename(path)
+    checks = [
+        # (name, current, previous, tolerated fraction of previous) —
+        # tolerances absorb window weather on the tunnel (on-chip
+        # timings swing far less than 2x between windows).
+        ("learner_env_frames_per_sec", result.get("value"),
+         prev.get("value"), 0.5),
+        ("ingraph_env_frames_per_sec",
+         diag.get("ingraph_env_frames_per_sec"),
+         prev.get("ingraph_env_frames_per_sec"), 0.3),
+        ("mfu", diag.get("mfu"), prev.get("mfu"), 0.5),
+    ]
+    for name, cur, old, tol in checks:
+        if not old:
+            continue
+        if cur is None:
+            # A missing headline metric IS the worst regression — the
+            # stage that produced it last round yielded nothing now.
+            diag["errors"].append(
+                f"REGRESSION: {name} missing this round (previous "
+                f"round: {old}, {os.path.basename(path)})")
+        elif cur < old * tol:
+            diag["errors"].append(
+                f"REGRESSION: {name} {cur} is below {tol:.0%} of the "
+                f"previous round's {old} ({os.path.basename(path)})")
 
 
 def main():
@@ -829,6 +1096,8 @@ def main():
         "vs_baseline": 0.0,
     }
     diag = {"errors": [], "stage": "probe"}
+    start_monotonic = time.monotonic()
+    deadline = start_monotonic + TOTAL_TIMEOUT_S
 
     # Exactly-one-JSON-line contract: both the watchdog and the normal
     # path funnel through this once-only emitter.
@@ -923,6 +1192,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_ingraph failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_learning"
+    try:
+        bench_learning(
+            diag, budget_s=120.0 if diag["platform"] != "cpu" else 90.0)
+    except Exception:
+        diag["errors"].append(
+            "bench_learning failed: " + traceback.format_exc(limit=3))
     diag["stage"] = "bench_kernels"
     try:
         bench_kernels(diag)
@@ -941,6 +1217,18 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_learner_b256 failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "e2e_link_retry"
+    try:
+        maybe_retry_e2e(diag, start_monotonic, deadline)
+    except Exception:
+        diag["errors"].append(
+            "e2e retry stage failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "regression_guard"
+    try:
+        regression_guard(result, diag)
+    except Exception:
+        diag["errors"].append(
+            "regression guard failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()
 

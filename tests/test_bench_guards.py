@@ -1,0 +1,130 @@
+"""Unit tests for bench.py's link-gated e2e retry and regression guard.
+
+Both are round-5 additions (round-4 VERDICT items 2 and 7): the retry
+must re-run the e2e stage only when a probe window clears the bandwidth
+threshold and must log every probe either way; the guard must flag a
+silent drop vs the previous round's committed artifact.  The stages are
+exercised hermetically by stubbing the probe and the e2e stage.
+"""
+
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def fake_clock(monkeypatch):
+    """time.monotonic()/time.sleep() on a virtual clock: sleeping
+    advances time instantly, so deadline-bounded loops terminate after
+    their real number of iterations without wall-clock waiting."""
+    t = [time.monotonic()]
+    monkeypatch.setattr(time, "monotonic", lambda: t[0])
+    monkeypatch.setattr(
+        time, "sleep", lambda s: t.__setitem__(0, t[0] + s))
+    return t
+
+
+def _base_diag():
+    return {"errors": [], "platform": "tpu",
+            "e2e_env_frames_per_sec": 12000.0,
+            "e2e_updates_measured": 30,
+            "e2e_vs_baseline": 0.4}
+
+
+class TestRetry:
+    def test_promotes_retry_on_healthy_link(self, monkeypatch,
+                                           fake_clock):
+        monkeypatch.setattr(bench, "_probe_h2d_mb_s", lambda: 800.0)
+
+        def fake_e2e(result, diag, budget_s, platform):
+            diag["e2e_env_frames_per_sec"] = 31000.0
+            diag["e2e_updates_measured"] = 30
+            diag["e2e_vs_baseline"] = 1.033
+
+        monkeypatch.setattr(bench, "bench_end_to_end", fake_e2e)
+        diag = _base_diag()
+        now = time.monotonic()
+        bench.maybe_retry_e2e(diag, now, now + 3600)
+        assert diag["e2e_env_frames_per_sec"] == 31000.0
+        assert diag["e2e_vs_baseline"] == 1.033
+        assert diag["e2e_first_attempt"]["e2e_env_frames_per_sec"] == (
+            12000.0)
+        assert diag["e2e_link_probes"][0]["h2d_mb_s"] == 800.0
+        assert diag["e2e_retry_verdict"] == "retry promoted to headline"
+
+    def test_keeps_first_attempt_when_retry_is_worse(self, monkeypatch,
+                                                     fake_clock):
+        monkeypatch.setattr(bench, "_probe_h2d_mb_s", lambda: 800.0)
+
+        def fake_e2e(result, diag, budget_s, platform):
+            diag["e2e_env_frames_per_sec"] = 9000.0
+            diag["e2e_updates_measured"] = 30
+            diag["e2e_vs_baseline"] = 0.3
+
+        monkeypatch.setattr(bench, "bench_end_to_end", fake_e2e)
+        diag = _base_diag()
+        now = time.monotonic()
+        bench.maybe_retry_e2e(diag, now, now + 3600)
+        assert diag["e2e_env_frames_per_sec"] == 12000.0  # unchanged
+        assert diag["e2e_retry"]["e2e_env_frames_per_sec"] == 9000.0
+
+    def test_logs_probes_when_link_never_recovers(self, monkeypatch,
+                                                  fake_clock):
+        monkeypatch.setattr(bench, "_probe_h2d_mb_s", lambda: 60.0)
+        called = []
+        monkeypatch.setattr(
+            bench, "bench_end_to_end",
+            lambda *a, **k: called.append(1))
+        diag = _base_diag()
+        now = time.monotonic()
+        bench.maybe_retry_e2e(diag, now, now + 400)
+        assert not called, "e2e must not re-run on a degraded link"
+        assert 1 <= len(diag["e2e_link_probes"]) <= 10
+        assert all(p["h2d_mb_s"] == 60.0
+                   for p in diag["e2e_link_probes"])
+        assert "no probe reached" in diag["e2e_retry_verdict"]
+
+    def test_skips_when_already_at_baseline(self, monkeypatch):
+        monkeypatch.setattr(
+            bench, "_probe_h2d_mb_s",
+            lambda: (_ for _ in ()).throw(AssertionError("probed")))
+        diag = _base_diag()
+        diag["e2e_vs_baseline"] = 1.2
+        now = time.monotonic()
+        bench.maybe_retry_e2e(diag, now, now + 3600)
+        assert "e2e_link_probes" not in diag
+
+    def test_skips_on_cpu_fallback(self, monkeypatch):
+        diag = _base_diag()
+        diag["platform"] = "cpu"
+        now = time.monotonic()
+        bench.maybe_retry_e2e(diag, now, now + 3600)
+        assert "e2e_link_probes" not in diag
+
+
+class TestRegressionGuard:
+    """Runs against the repo's real committed BENCH_r*.json artifact."""
+
+    def test_flags_learner_regression(self):
+        diag = {"errors": [], "platform": "tpu",
+                "ingraph_env_frames_per_sec": 150000.0, "mfu": 0.15}
+        result = {"value": 1000.0}  # far below any recorded round
+        bench.regression_guard(result, diag)
+        if "regression_reference" not in diag:
+            pytest.skip("no comparable committed BENCH artifact")
+        assert any("REGRESSION" in e for e in diag["errors"])
+
+    def test_passes_at_parity(self):
+        diag = {"errors": [], "platform": "tpu",
+                "ingraph_env_frames_per_sec": 150000.0, "mfu": 0.15}
+        result = {"value": 2.5e6}
+        bench.regression_guard(result, diag)
+        assert not [e for e in diag["errors"] if "REGRESSION" in e]
+
+    def test_silent_on_platform_mismatch(self):
+        diag = {"errors": [], "platform": "cpu"}
+        result = {"value": 1.0}
+        bench.regression_guard(result, diag)
+        assert diag["errors"] == []

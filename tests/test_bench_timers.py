@@ -20,6 +20,8 @@ methodology: four bugs").
    ^(carry != carry) — all runtime zero).
 """
 
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -56,14 +58,23 @@ def _lowered_scan_text(fn, args, iters=3):
 @pytest.mark.smoke
 class TestPipelinedTimerLiveness:
     def test_backward_pass_stays_live(self):
-        """value_and_grad over both operands must keep 3 dot_generals
-        (1 forward + 2 backward) in the compiled scan body."""
+        """value_and_grad over both operands must keep the backward
+        dot_generals (1 forward + 2 backward) live in the compiled scan
+        body.  Bounds + a forward-only negative control rather than an
+        exact count: printer dialects change across JAX releases."""
         x = jnp.asarray(np.random.randn(32, 32).astype(np.float32))
         w = jnp.asarray(np.random.randn(32, 32).astype(np.float32))
         vg = jax.value_and_grad(
             lambda a, b: jnp.sum((a @ b) ** 2), argnums=(0, 1))
         txt = _lowered_scan_text(vg, (x, w))
-        assert txt.count("dot_general") == 3
+        fwd_txt = _lowered_scan_text(
+            lambda a, b: jnp.sum((a @ b) ** 2), (x, w))
+        n_vg = len(re.findall(r"dot_general", txt))
+        n_fwd = len(re.findall(r"dot_general", fwd_txt))
+        assert n_fwd >= 1
+        assert n_vg >= n_fwd + 2, (
+            f"backward matmuls missing: {n_vg} dot_generals in "
+            f"value_and_grad vs {n_fwd} forward-only")
 
     def test_unseeded_arg_preprocessing_stays_in_loop(self):
         """uint8 'frames' whose preprocessing depends on no float input
@@ -80,10 +91,12 @@ class TestPipelinedTimerLiveness:
                 lambda q: jnp.sum((xx @ q) ** 2))(wt)
 
         txt = _lowered_scan_text(stage, (frames, w))
-        assert "compare  NE" in txt  # carry != carry (runtime zero)
-        assert "ui8" in txt and "divide" in txt
+        # carry != carry (runtime zero); whitespace/dialect-tolerant
+        assert re.search(r"compare\s+NE", txt)
+        assert re.search(r"\bui?8\b|ui8", txt) and "divide" in txt
         # the perturb add on the uint8 leaf exists inside the program
-        assert any("add" in line and "ui8" in line
+        assert any(re.search(r"\badd", line)
+                   and re.search(r"ui?8", line)
                    for line in txt.splitlines())
 
     def test_bool_leaves_perturbed(self):
@@ -93,7 +106,7 @@ class TestPipelinedTimerLiveness:
         f = jnp.asarray(np.random.randn(16).astype(np.float32))
         txt = _lowered_scan_text(
             lambda d, x: jnp.where(d, x, -x).sum(), (done, f))
-        assert any(("xor" in line and "i1" in line)
+        assert any(("xor" in line and re.search(r"i1\b", line))
                    for line in txt.splitlines())
 
     def test_perturbation_is_value_exact(self):
@@ -133,6 +146,31 @@ class TestPipelinedTimerLiveness:
 
     def test_timer_returns_nonnegative(self):
         x = jnp.ones((64, 64))
-        us = bench._timed_us_pipelined(
+        us, floor_us = bench._timed_us_pipelined(
             lambda a: jnp.tanh(a).sum(), (x,), iters=5)
         assert us >= 0.0
+        assert floor_us >= 0.0
+
+    def test_integer_only_outputs_stay_live(self):
+        """A stage whose compute feeds ONLY integer outputs (argmax
+        actions) must still keep its matmul live — integer leaves fold
+        into the carry too (round-4 ADVICE)."""
+        x = jnp.asarray(np.random.randn(16, 16).astype(np.float32))
+        w = jnp.asarray(np.random.randn(16, 16).astype(np.float32))
+        txt = _lowered_scan_text(
+            lambda a, b: jnp.argmax(a @ b, axis=-1), (x, w))
+        assert re.search(r"dot_general", txt), (
+            "integer-only stage was dead-code-eliminated")
+
+    def test_record_timed_clamps_to_floor(self):
+        """Sub-resolution readings are published as the floor with an
+        explanatory note, never as 0.0 (round-4 VERDICT item 7)."""
+        diag = {}
+        orig = bench._timed_us_pipelined
+        bench._timed_us_pipelined = lambda *a, **k: (0.0, 3.7)
+        try:
+            bench._record_timed(diag, "kernel_x_us", None, (), 5)
+        finally:
+            bench._timed_us_pipelined = orig
+        assert diag["kernel_x_us"] == 3.7
+        assert "below timer resolution" in diag["kernel_x_us_note"]
