@@ -495,9 +495,10 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
     # other's action-fetch RTT, reaching ~80% of the pure-bandwidth
     # ceiling); 3 shards regressed to 12.6k (uneven 2/2/1 group split
     # + host thread contention on one core).
-    fused_shards = int(os.environ.get("BENCH_E2E_SHARDS", "2"))
-    if inference_mode == "accum_fused":
-        diag["e2e_config"]["fused_shards"] = fused_shards
+    # 0 = auto: the pool probes the link and picks the shard count
+    # from the RTT-floor model (runtime/linktune.py); the resolved
+    # value and probe land in the diag below.
+    fused_shards = int(os.environ.get("BENCH_E2E_SHARDS", "0"))
     pool = ActorPool(agent, groups, unroll_len,
                      level_name="fake_benchmark",
                      inference_mode=inference_mode,
@@ -505,6 +506,10 @@ def bench_end_to_end(result, diag, budget_s=240.0, platform="tpu"):
                      queue_capacity=(num_groups
                                      if inference_mode == "accum_fused"
                                      else 2))
+    if inference_mode == "accum_fused":
+        diag["e2e_config"]["fused_shards"] = getattr(
+            pool, "fused_shards", fused_shards)
+        diag["e2e_config"]["fused_shards_auto"] = fused_shards == 0
     pool.set_params(state.params)
     pool.start()
 
@@ -928,14 +933,15 @@ def bench_learning(diag, budget_s=120.0):
     diag["learning_optimal_return"] = 16.0
     final = float(np.mean([r for _, r in curve[-2:]]))
     diag["learning_final_return"] = round(final, 2)
-    improved = (done >= 50 and final >= target_return
-                and final > curve[0][1] + 1.0)
+    # The bar is the RANDOM floor, not the first logged window — an
+    # agent that converges inside the first chunk is a success, not a
+    # failed improvement.
+    improved = done >= 50 and final >= target_return
     diag["learning_improved"] = bool(improved)
     if not improved:
         diag["errors"].append(
             f"learning verdict FAILED: final return {final:.2f} "
             f"(random {random_return}, target >= {target_return}, "
-            f"first window {curve[0][1] if curve else 'n/a'}, "
             f"{done} updates)")
 
 
@@ -944,17 +950,13 @@ E2E_RETRY_BW_THRESHOLD_MB_S = float(
 
 
 def _probe_h2d_mb_s():
-    """One-shot H2D bandwidth probe: one 16 MB upload synchronized by a
-    value fetch (~1 RTT included, so a slight under-estimate — the
-    honest direction for a go/no-go gate)."""
-    import jax
-    import numpy as np
+    """H2D bandwidth probe for the retry gate: one 16 MB upload with
+    the fetch RTT subtracted (runtime/linktune.py probe_link — without
+    the subtraction a 67 ms-RTT link can never read above ~250 MB/s,
+    making a 300 MB/s gate unreachable even on a recovered wire)."""
+    from scalable_agent_tpu.runtime.linktune import probe_link
 
-    d = jax.devices()[0]
-    big = np.zeros((16 << 20,), np.uint8)
-    t0 = time.perf_counter()
-    float(np.asarray(jax.device_put(big, d)[0]))
-    return 16.0 / (time.perf_counter() - t0)
+    return probe_link(upload_bytes=16 << 20).h2d_bytes_per_s / 1e6
 
 
 def maybe_retry_e2e(diag, start_monotonic, deadline):
@@ -999,7 +1001,7 @@ def maybe_retry_e2e(diag, start_monotonic, deadline):
         return
     first = {k: diag.get(k) for k in (
         "e2e_env_frames_per_sec", "e2e_updates_measured",
-        "e2e_vs_baseline")}
+        "e2e_vs_baseline", "e2e_config")}
     sub = {"errors": diag["errors"]}
     budget = min(420.0, deadline - time.monotonic() - margin_s)
     diag["e2e_retry_budget_s"] = round(budget, 0)
@@ -1019,6 +1021,11 @@ def maybe_retry_e2e(diag, start_monotonic, deadline):
         for k in ("e2e_env_frames_per_sec", "e2e_updates_measured",
                   "e2e_vs_baseline"):
             diag[k] = sub[k]
+        if sub.get("e2e_config"):
+            # The headline must describe the run it came from (the
+            # retry's own auto-resolved shard count, not the first
+            # attempt's).
+            diag["e2e_config"] = sub["e2e_config"]
         diag["e2e_retry_verdict"] = "retry promoted to headline"
     else:
         diag["e2e_retry"] = {k: sub.get(k) for k in (
@@ -1047,8 +1054,14 @@ def regression_guard(result, diag):
             f"regression guard: unreadable {os.path.basename(path)}")
         return
     prev = raw if isinstance(raw, dict) and "metric" in raw else None
+    if (prev is None and isinstance(raw, dict)
+            and isinstance(raw.get("parsed"), dict)
+            and "metric" in raw["parsed"]):
+        # Driver artifact format: the already-parsed bench dict.
+        prev = raw["parsed"]
     if prev is None and isinstance(raw, dict) and "tail" in raw:
-        # Driver artifact format: the bench JSON line is inside `tail`.
+        # Older driver artifacts: the bench JSON line inside `tail`
+        # (may be truncated mid-line — best effort).
         for line in reversed(str(raw["tail"]).splitlines()):
             line = line.strip()
             if line.startswith("{"):

@@ -99,13 +99,16 @@ class Config:
     inference_mode: str = "structural"
     # accum_fused only: number of lockstep shards the group fleet
     # splits into (separate threads).  1 = one device call serves ALL
-    # groups (minimum RTTs); 2 lets one shard's upload + env stepping
-    # overlap the other's link round trip — measured 1.6-1.8x e2e on
-    # bandwidth-constrained links (BENCH_NOTES r4 sweep; 3 shards
-    # regressed).  Default 2: accum_fused exists for RTT/bandwidth-
-    # dominated attachments, where overlap wins; the pool clamps to
-    # the group count, so single-group fleets degrade to 1.
-    accum_fused_shards: int = 2
+    # groups (minimum RTTs, right co-located); 2 lets one shard's
+    # upload + env stepping overlap the other's link round trip —
+    # measured 1.6-1.8x e2e on bandwidth-constrained links
+    # (BENCH_NOTES r4 sweep; 3 shards regressed).  Default 0 = AUTO:
+    # the pool probes the link at startup (RTT + H2D bandwidth) and
+    # picks the predicted-best count from the RTT-floor model
+    # (runtime/linktune.py) — so co-located chips get 1 and degraded
+    # tunnels get 2 without per-deployment tuning.  The pool clamps
+    # explicit values to the group count.
+    accum_fused_shards: int = 0
     # Training backend: "host" (actor pool + prefetch + learner — the
     # reference's architecture, experiment.py:479-672) or "ingraph"
     # (rollout + update fused into ONE jitted device program for

@@ -181,7 +181,7 @@ class ActorPool:
         inference_mode: str = "structural",
         service_timeout_ms: float = 5.0,
         observation_spec=None,
-        fused_shards: int = 1,
+        fused_shards: int = 0,
     ):
         # Inference runs on ONE device (by default the first): actor
         # threads must never launch multi-device SPMD programs — concurrent
@@ -255,7 +255,28 @@ class ActorPool:
                 # fully-threaded accum (k RTTs) and one lockstep batch
                 # (no overlap).  Same per-group seeds as the threaded
                 # path either way, so trajectories are identical.
-                shards = max(1, min(fused_shards, len(env_groups)))
+                # 0 = auto: probe the link at startup and pick the
+                # predicted-best count (1 co-located, 2 on the
+                # bandwidth-bound tunnel — runtime/linktune.py).
+                from scalable_agent_tpu.runtime.linktune import (
+                    resolve_fused_shards,
+                )
+                from scalable_agent_tpu.utils import log
+
+                frame_shape = env_groups[0].frame_slab().shape[1:]
+                shards, link = resolve_fused_shards(
+                    fused_shards, len(env_groups),
+                    env_groups[0].num_envs,
+                    int(np.prod(frame_shape)),
+                    device=self._inference_device)
+                if link is not None:
+                    log.info(
+                        "auto accum_fused_shards=%d (probed rtt "
+                        "%.1f ms, h2d %.0f MB/s, %d groups x %d envs)",
+                        shards, link.rtt_s * 1e3,
+                        link.h2d_bytes_per_s / 1e6, len(env_groups),
+                        env_groups[0].num_envs)
+                self.fused_shards = shards
                 # Balanced split: exactly ``shards`` drivers (e.g. 4
                 # groups over 3 shards -> [2, 1, 1]), so the config
                 # value means what it says.
