@@ -796,12 +796,6 @@ def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
         MultiAgentVectorEnv,
     )
 
-    if config.record_to:
-        # Per-player recording would need per-player directories threaded
-        # through the multiplayer factory; until then, ignoring the flag
-        # silently would be worse than saying so.
-        log.info("record_to is not supported for multi-agent eval; "
-                 "no recordings will be written")
     matches = max(1, config.test_batch_size // num_agents)
     if matches * num_agents != config.test_batch_size:
         # Eval batch is throughput sizing, not a correctness property
@@ -826,6 +820,14 @@ def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
             seed=config.seed * 977 + 131 * (proc * matches + m),
             port_base=DEFAULT_UDP_PORT + stride * (proc * matches + m),
             port_increment=stride * total,
+            # One directory per (level, match); the multiplayer factory
+            # adds per-player subdirs beneath it, so parallel matches
+            # and players never interleave episode streams (role of
+            # the reference's record path, env_wrappers.py:433-497).
+            **(dict(record_to=os.path.join(
+                config.record_to, config.level_name,
+                f"match_{proc * matches + m:02d}"))
+               if config.record_to else {}),
             **env_kwargs(config))
         for m in range(matches)
     ])

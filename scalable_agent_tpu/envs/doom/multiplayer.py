@@ -523,9 +523,28 @@ def make_doom_multiplayer_env(
             # realistic num_agents can alias the match-seed digits.
             match_seed = 0 if seed is None else seed
             base.seed(match_seed * 1000 + player_id + 1)
-        return assemble_doom_env(
+        player_kwargs = dict(kwargs)
+        # Per-player recording: every player writes its own episode
+        # stream into <record_to>/player_NN — a shared directory would
+        # interleave concurrent player threads' episode numbering
+        # (role of the reference's record path,
+        # envs/env_wrappers.py:433-497, which is single-agent only).
+        # The wrapper goes OUTSIDE the assembled pipeline so recordings
+        # carry what the policy saw (resized frames, shaped rewards) —
+        # the same convention as single-agent eval recording
+        # (envs/__init__.py make_impala_stream).  Probe envs
+        # (player_id=-1) never record.
+        record_to = player_kwargs.pop("record_to", None)
+        assembled = assemble_doom_env(
             spec, width=width, height=height, env=base, num_bots=bots,
-            **kwargs)
+            **player_kwargs)
+        if record_to and player_id >= 0:
+            from scalable_agent_tpu.envs.wrappers import RecordingWrapper
+
+            assembled = RecordingWrapper(
+                assembled,
+                os.path.join(record_to, f"player_{player_id:02d}"))
+        return assembled
 
     if is_multiagent:
         return MultiAgentEnv(agents, make_player_env,

@@ -27,9 +27,8 @@ GPU over gRPC and never face this trade (reference:
 experiment.py:497-512).
 """
 
-import math
 import time
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import numpy as np
 

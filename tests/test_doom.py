@@ -334,6 +334,30 @@ class TestMultiplayer:
         finally:
             env.close()
 
+    def test_per_player_recording(self, tmp_path):
+        """record_to on a multi-agent level: each player writes its own
+        episode stream under player_NN (role of the reference's record
+        path, envs/env_wrappers.py:433-497, extended to multi-agent)."""
+        from scalable_agent_tpu.envs import create_env
+
+        record_dir = tmp_path / "rec"
+        env = create_env("doom_duel", num_action_repeats=4,
+                         record_to=str(record_dir))
+        try:
+            env.reset()
+            action = (0, 0, 0, 0, 0, 0, 10)
+            for _ in range(16):  # past one episode boundary
+                env.step([action, action])
+        finally:
+            env.close()  # flushes the in-flight episode
+        for player in ("player_00", "player_01"):
+            episodes = sorted((record_dir / player).glob("episode_*"))
+            assert episodes, f"no recordings for {player}"
+            assert (episodes[0] / "frames.npy").exists()
+            assert (episodes[0] / "episode.json").exists()
+            frames = np.load(episodes[0] / "frames.npy")
+            assert frames.ndim == 4 and frames.shape[-1] == 3
+
     def test_host_and_join_args(self):
         from scalable_agent_tpu.envs.doom.multiplayer import (
             DoomMultiplayerEnv)
@@ -700,12 +724,26 @@ class TestDriverMultiAgent:
         )
         train(Config(mode="train",
                      total_environment_frames=2 * 3 * 2 * 4, **common))
+        record_dir = tmp_path / "recordings"
         returns = run_test(Config(
             mode="test", test_num_episodes=4, test_batch_size=4,
-            **common))
+            record_to=str(record_dir), **common))
         assert list(returns) == ["doom_duel"]
         assert len(returns["doom_duel"]) == 4
         assert all(np.isfinite(r) for r in returns["doom_duel"])
+        # Multi-agent eval recording: per-match, per-player episode
+        # files (round-4 VERDICT item 6; reference record path is
+        # single-agent only, env_wrappers.py:433-497).
+        match_dirs = sorted((record_dir / "doom_duel").glob("match_*"))
+        assert match_dirs, "no match recording directories"
+        for match in match_dirs:
+            players = sorted(match.glob("player_*"))
+            assert len(players) == 2, match
+            for player in players:
+                episodes = sorted(player.glob("episode_*"))
+                assert episodes, f"no episodes recorded in {player}"
+                assert (episodes[0] / "frames.npy").exists()
+                assert (episodes[0] / "episode.json").exists()
 
     def test_batch_size_must_divide_by_agents(self, tmp_path):
         from scalable_agent_tpu.config import Config
