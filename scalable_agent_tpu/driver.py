@@ -824,10 +824,10 @@ def _eval_multi_agent(config: Config, agent: ImpalaAgent, params, step_fn,
             # adds per-player subdirs beneath it, so parallel matches
             # and players never interleave episode streams (role of
             # the reference's record path, env_wrappers.py:433-497).
-            **(dict(record_to=os.path.join(
+            record_to=(os.path.join(
                 config.record_to, config.level_name,
-                f"match_{proc * matches + m:02d}"))
-               if config.record_to else {}),
+                f"match_{proc * matches + m:02d}")
+                if config.record_to else None),
             **env_kwargs(config))
         for m in range(matches)
     ])

@@ -315,6 +315,14 @@ class MultiAgentEnv:
             return
         for worker in self._workers:
             worker.task_queue.put((None, _TaskType.TERMINATE))
+        # Await the terminations: the TERMINATE task runs env.close(),
+        # which now includes RecordingWrapper's final-episode flush —
+        # fire-and-forget on daemon threads would race that write with
+        # process exit (or with a caller reading recordings right after
+        # close()).  Bounded join so a wedged VizDoom can't hang
+        # teardown.
+        for worker in self._workers:
+            worker.thread.join(timeout=10.0)
         self._workers = None
 
     # -- lockstep protocol -------------------------------------------------
@@ -541,9 +549,15 @@ def make_doom_multiplayer_env(
         if record_to and player_id >= 0:
             from scalable_agent_tpu.envs.wrappers import RecordingWrapper
 
+            inner = assembled
             assembled = RecordingWrapper(
-                assembled,
-                os.path.join(record_to, f"player_{player_id:02d}"))
+                inner, os.path.join(record_to, f"player_{player_id:02d}"))
+            # assemble_doom_env pins native_action_repeats on its
+            # outermost wrapper (wrappers don't forward arbitrary
+            # attributes, specs.py) — re-establish the invariant on the
+            # new outermost layer.
+            assembled.native_action_repeats = getattr(
+                inner, "native_action_repeats", 1)
         return assembled
 
     if is_multiagent:

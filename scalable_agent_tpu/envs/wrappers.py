@@ -336,7 +336,12 @@ class RecordingWrapper(Wrapper):
         self._rewards = []
 
     def _flush(self):
-        if self._episode >= 0 and self._frames:
+        # Gate on recorded ACTIONS, not frames: a reset-reset sequence
+        # with no steps between (multiplayer worker INIT reset followed
+        # by the aggregator's initial()) leaves one lone reset frame,
+        # and flushing it would pollute every stream with a degenerate
+        # 0-action leading episode.
+        if self._episode >= 0 and self._actions:
             ep_dir = os.path.join(self._dir, f"episode_{self._episode:05d}")
             os.makedirs(ep_dir, exist_ok=True)
             np.save(os.path.join(ep_dir, "frames.npy"),
@@ -349,8 +354,12 @@ class RecordingWrapper(Wrapper):
                 }, f)
 
     def reset(self):
-        self._flush()
-        self._episode += 1
+        # Advance the episode number only past episodes that actually
+        # stepped — a stepless reset (see _flush) reuses its number, so
+        # recordings are consecutive from episode_00000.
+        if self._episode < 0 or self._actions:
+            self._flush()
+            self._episode += 1
         self._frames, self._actions, self._rewards = [], [], []
         observation = self.env.reset()
         self._frames.append(np.asarray(observation.frame))

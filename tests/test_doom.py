@@ -350,12 +350,21 @@ class TestMultiplayer:
                 env.step([action, action])
         finally:
             env.close()  # flushes the in-flight episode
+        import json as json_lib
+
         for player in ("player_00", "player_01"):
             episodes = sorted((record_dir / player).glob("episode_*"))
             assert episodes, f"no recordings for {player}"
-            assert (episodes[0] / "frames.npy").exists()
-            assert (episodes[0] / "episode.json").exists()
+            # Consecutive numbering from 0 — the worker-INIT double
+            # reset must not leave a degenerate leading episode.
+            assert episodes[0].name == "episode_00000"
             frames = np.load(episodes[0] / "frames.npy")
+            meta = json_lib.load(open(episodes[0] / "episode.json"))
+            # Real gameplay, not a reset artifact: steps were recorded
+            # and frames = initial + one per action.
+            assert len(meta["actions"]) >= 1
+            assert len(meta["actions"]) == len(meta["rewards"])
+            assert frames.shape[0] == len(meta["actions"]) + 1
             assert frames.ndim == 4 and frames.shape[-1] == 3
 
     def test_host_and_join_args(self):

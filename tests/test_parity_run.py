@@ -1,0 +1,133 @@
+"""The 1B-frame parity-run recipe (docs/parity_run.md) stays honest.
+
+Three guarantees back the recipe:
+
+1. The framework defaults ARE the reference's single-level
+   hyperparameters, so the documented two-flag launch reproduces the
+   reference run (reference: experiment.py:61-95, README.md:40-42).
+2. Resuming a checkpoint twice is bit-deterministic on the ingraph
+   backend at the parity unroll length (T=100): identical params,
+   identical loss sequences — a preempted 1e9-frame run resumed on a
+   different day converges identically.
+3. The frame-keyed LR schedule continues at the exact analytic
+   position after resume (host and ingraph share the Learner, so one
+   backend's check covers the schedule math; the host backend's
+   continuation bookkeeping is covered in test_driver.py).
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+
+def _read_rows(logdir):
+    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+class TestDocumentedConfig:
+    def test_defaults_match_reference_single_level_recipe(self):
+        """docs/parity_run.md claims the two-flag launch inherits the
+        reference hyperparameters from the defaults — pin them."""
+        from scalable_agent_tpu.config import Config
+
+        c = Config()
+        assert c.learning_rate == 0.00048
+        assert c.entropy_cost == 0.00025
+        assert c.baseline_cost == 0.5
+        assert c.discounting == 0.99
+        assert c.reward_clipping == "abs_one"
+        assert c.rmsprop_decay == 0.99
+        assert c.rmsprop_momentum == 0.0
+        assert c.rmsprop_epsilon == 0.1
+        assert c.unroll_length == 100
+        assert c.batch_size == 32
+        assert c.num_action_repeats == 4
+        assert c.total_environment_frames == 1e9
+
+    def test_doc_carries_the_dmlab30_hyperparameters(self):
+        """The suite run's tuned values must appear verbatim in the doc
+        (reference: README.md:56-62)."""
+        doc = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                "docs", "parity_run.md")).read()
+        assert "0.0033391318945337044" in doc  # entropy_cost
+        assert "0.00031866995608948655" in doc  # learning_rate
+        assert "10000000000" in doc  # 1e10 frames
+        assert "soft_asymmetric" in doc
+        assert "--num_actors=150" in doc
+        assert "--level_name=dmlab30" in doc
+
+
+@pytest.mark.slow
+class TestResumeDeterminism:
+    """Parity-unroll (T=100) resume semantics, ingraph backend."""
+
+    T, B, REPEATS = 100, 8, 4
+    FPU = B * T * REPEATS  # 3200 frames/update
+
+    def _config(self, logdir, updates):
+        from scalable_agent_tpu.config import Config
+
+        return Config(
+            mode="train", level_name="fake_benchmark",
+            train_backend="ingraph", logdir=str(logdir),
+            num_actors=self.B, batch_size=self.B,
+            unroll_length=self.T, num_action_repeats=self.REPEATS,
+            total_environment_frames=float(updates * self.FPU),
+            compute_dtype="float32",
+            checkpoint_interval_s=1e9,  # only the forced end-of-run save
+            log_interval_s=0.0)  # log every update
+
+    def test_resume_twice_is_bit_identical(self, tmp_path):
+        from scalable_agent_tpu import driver
+        from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
+
+        # Leg A: one update, checkpoint at its end.
+        dir_a = tmp_path / "run"
+        driver.train(self._config(dir_a, updates=1))
+
+        # Two independent resumes from the SAME checkpoint.
+        dir_b1, dir_b2 = tmp_path / "b1", tmp_path / "b2"
+        shutil.copytree(dir_a, dir_b1)
+        shutil.copytree(dir_a, dir_b2)
+        m1 = driver.train(self._config(dir_b1, updates=3))
+        m2 = driver.train(self._config(dir_b2, updates=3))
+
+        assert m1["env_frames"] == m2["env_frames"] == 3 * self.FPU
+        # Loss sequences after resume are identical row for row.
+        tail1 = [r["total_loss"] for r in _read_rows(str(dir_b1))
+                 if "total_loss" in r]
+        tail2 = [r["total_loss"] for r in _read_rows(str(dir_b2))
+                 if "total_loss" in r]
+        assert len(tail1) >= 3  # leg A's update + two resumed ones
+        assert tail1 == tail2
+        # Final checkpoints are bit-identical, leaf by leaf.
+        s1, state1 = CheckpointManager(str(dir_b1)).restore()
+        s2, state2 = CheckpointManager(str(dir_b2)).restore()
+        assert s1 == s2 == 3
+        leaves1 = jax_leaves(state1)
+        leaves2 = jax_leaves(state2)
+        assert len(leaves1) == len(leaves2) and len(leaves1) > 0
+        for l1, l2 in zip(leaves1, leaves2):
+            np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    def test_lr_resumes_at_exact_schedule_position(self, tmp_path):
+        from scalable_agent_tpu import driver
+
+        dir_a = tmp_path / "run"
+        driver.train(self._config(dir_a, updates=1))
+        metrics = driver.train(self._config(dir_a, updates=3))
+        # The last update computed its LR from the pre-update frame
+        # count (2 * FPU of 3 * FPU consumed): linear decay to zero.
+        expected = 0.00048 * (1.0 - (2 * self.FPU) / (3 * self.FPU))
+        np.testing.assert_allclose(
+            metrics["learning_rate"], expected, rtol=1e-6)
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
