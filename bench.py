@@ -638,6 +638,79 @@ def bench_kernels(diag):
                           lambda *a: vg(a), args, iters=200)
 
 
+def bench_convs(diag):
+    """Per-layer conv diagnostics at the B=256 merged batch
+    ([101*256, H, W, C]), each timed at its REAL gradient requirement:
+    the stem's input is the gradient-free uint8 frame, so conv_0 is
+    grad-wrt-weights only, while conv_1/conv_2 need input gradients for
+    the chain.  These are the numbers behind the round-5 MFU-ceiling
+    analysis (BENCH_NOTES round-5 conv table): each layer runs at its
+    output-lane utilization cap (32/128, 64/128, 128/128), so the
+    update's ~0.16 MFU is the reference architecture's shape ceiling,
+    not a lowering defect.  The s2d entry tracks the (negative-result)
+    space-to-depth stem across rounds.  TPU only."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() != "tpu":
+        return
+    n = 101 * 256
+    peak = _peak_flops(jax.devices()[0].device_kind) or 1.0
+
+    def dev_randn(key, shape, scale=1.0):
+        # Generated ON device: a collapsed tunnel cannot upload the
+        # ~1 GB merged-batch activations.
+        return jax.jit(lambda: (jax.random.normal(
+            jax.random.key(key), shape, jnp.float32) * scale
+        ).astype(jnp.bfloat16))()
+
+    def conv(x, w, stride):
+        return lax.conv_general_dilated(
+            x, w, (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def timed(name, x_shape, w_shape, stride, argnums, flops_fwd,
+              fn=None):
+        x = dev_randn(1, x_shape)
+        w = dev_randn(2, w_shape, 0.05)
+        op = fn or (lambda xx, ww: conv(xx, ww, stride))
+        vg = jax.value_and_grad(
+            lambda xx, ww: jnp.sum(
+                op(xx, ww).astype(jnp.float32) ** 2),
+            argnums=argnums)
+        _record_timed(diag, name, lambda a, b: vg(a, b), (x, w),
+                      iters=12)
+        us = diag[name]
+        # fwd + ~2x bwd per differentiated operand set: grad-w-only is
+        # ~2x fwd work, grad-(x,w) ~3x.
+        mult = 2 if argnums == (1,) else 3
+        diag[name.replace("_us", "_mfu")] = round(
+            mult * flops_fwd / (us * 1e-6) / peak, 3)
+
+    timed("kernel_conv0_gradw_us", (n, 72, 96, 3), (8, 8, 3, 32), 4,
+          (1,), n * 18 * 24 * (8 * 8 * 3) * 32 * 2)
+    timed("kernel_conv1_gradxw_us", (n, 18, 24, 32), (4, 4, 32, 64), 2,
+          (0, 1), n * 9 * 12 * (4 * 4 * 32) * 64 * 2)
+    timed("kernel_conv2_gradxw_us", (n, 9, 12, 64), (3, 3, 64, 128), 2,
+          (0, 1), n * 5 * 6 * (3 * 3 * 64) * 128 * 2)
+
+    def s2d_stem(xx, ww):
+        # The SHIPPED rearrangement (models/networks.py), so this
+        # cross-round diagnostic can never drift from the module.
+        from scalable_agent_tpu.models.networks import (
+            space_to_depth_rearrange,
+        )
+
+        xp, k = space_to_depth_rearrange(xx, ww)
+        return lax.conv_general_dilated(
+            xp, k, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    timed("kernel_conv0_gradw_s2d_us", (n, 72, 96, 3), (8, 8, 3, 32),
+          4, (1,), n * 18 * 24 * (8 * 8 * 3) * 32 * 2, fn=s2d_stem)
+
+
 def bench_roofline(diag):
     """Decompose the learner update (T=100, B=32, bf16 torso) into its
     stages — forward unroll, loss forward, loss+grad, optimizer — each
@@ -1218,6 +1291,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_kernels failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "bench_convs"
+    try:
+        bench_convs(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_convs failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "bench_roofline"
     try:
         bench_roofline(diag)

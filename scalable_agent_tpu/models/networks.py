@@ -16,6 +16,7 @@ run in bfloat16 (``dtype``) with float32 params.
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -25,23 +26,101 @@ def _normalize_frame(frame, dtype):
     return jnp.asarray(frame, dtype) / 255.0
 
 
+def space_to_depth_rearrange(x, kernel):
+    """The stem's space-to-depth re-indexing, as one pure function:
+    ``(x [N,H,W,C], kernel [8,8,C,F]) -> (x' [N,bh,bw,16C],
+    k' [2,2,16C,F])`` such that a VALID 2x2/stride-1 conv of the primed
+    pair equals the SAME 8x8/stride-4 conv of the originals.  Shared by
+    ``_SpaceToDepthFirstConv`` and bench.py's cross-round conv
+    diagnostic so the published timing always measures the shipped
+    formulation."""
+    n, height, width, c = x.shape
+    f = kernel.shape[-1]
+
+    # SAME padding for kernel 8 / stride 4; the padded extent
+    # (ceil(d/4) + 1) * 4 is always a multiple of the block size.
+    def pads(size):
+        total = max(0, (-(-size // 4) - 1) * 4 + 8 - size)
+        return total // 2, total - total // 2
+
+    x = jnp.pad(x, ((0, 0), pads(height), pads(width), (0, 0)))
+    bh, bw = x.shape[1] // 4, x.shape[2] // 4
+    x = x.reshape(n, bh, 4, bw, 4, c).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, bh, bw, 16 * c)
+    # kernel (kh, kw) -> (block, in-block) pairs, matching the
+    # (ph, pw, c) channel order the input rearrangement produced.
+    k = kernel.reshape(2, 4, 2, 4, c, f)
+    k = k.transpose(0, 2, 1, 3, 4, 5).reshape(2, 2, 16 * c, f)
+    return x, k
+
+
+class _SpaceToDepthFirstConv(nn.Module):
+    """The torso's 8x8/stride-4 stem conv, computed as space-to-depth(4)
+    + a 2x2/stride-1 conv — the classic TPU reformulation for
+    small-channel strided stems.  Measured on v5e at the bench shapes
+    (BENCH_NOTES round-5 conv table), it is a NEGATIVE result for THIS
+    architecture and stays off by default: the win only exists when the
+    conv's input gradient is computed (3.4x there), but the stem's
+    input is the uint8 frame — a gradient-free leaf — and with
+    weights-only backward the direct form is 2.3x FASTER than s2d
+    (XLA's native lowering already runs at the layer's output-lane
+    ceiling, and the explicit 1 GB block transpose is pure added HBM
+    traffic).  Kept because the measurement matters and because other
+    torso stacks (an image-gradient consumer) may want it.
+
+    Parameter tree, shapes, and initializers are IDENTICAL to the
+    ``nn.Conv(32, (8, 8), strides=4, padding="SAME")`` it replaces —
+    kernel [8, 8, C, F] + bias under the same module name — so
+    checkpoints are interchangeable both ways, and the rearrangement is
+    a pure re-indexing (numerically equal output up to contraction
+    order; tests/test_networks.py)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (8, 8, c, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        x, k = space_to_depth_rearrange(x, kernel)
+        x, k, b = (jnp.asarray(t, self.dtype) for t in (x, k, bias))
+        out = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out + b
+
+
 class ShallowConvTorso(nn.Module):
     """(32,8,4), (64,4,2), (128,3,2) conv stack + Dense(256).
 
     Input [N, H, W, C] uint8; output [N, 256] float32.
     (reference: experiment.py:178-189)
+
+    ``space_to_depth`` computes the stem conv in its space-to-depth
+    form — same parameters, same linear map.  Default OFF: measured
+    SLOWER for this torso, whose stem input needs no gradient (see
+    _SpaceToDepthFirstConv for the measurement story).
     """
 
     dtype: Any = jnp.float32
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, frame):
         x = _normalize_frame(frame, self.dtype)
         for i, (num_ch, filter_size, stride) in enumerate(
                 [(32, 8, 4), (64, 4, 2), (128, 3, 2)]):
-            x = nn.Conv(
-                num_ch, (filter_size, filter_size), strides=(stride, stride),
-                padding="SAME", dtype=self.dtype, name=f"conv_{i}")(x)
+            if i == 0 and self.space_to_depth:
+                x = _SpaceToDepthFirstConv(
+                    num_ch, dtype=self.dtype, name="conv_0")(x)
+            else:
+                x = nn.Conv(
+                    num_ch, (filter_size, filter_size),
+                    strides=(stride, stride),
+                    padding="SAME", dtype=self.dtype, name=f"conv_{i}")(x)
             x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(256, dtype=self.dtype, name="fc")(x)
