@@ -127,6 +127,16 @@ class Config:
     profile_dir: str = ""  # empty = disabled
     profile_start_update: int = 10
     profile_num_updates: int = 5
+    # Observability (obs/): --trace captures host pipeline spans (actor
+    # env-step/inference, batcher queues, learner update, checkpoint,
+    # h2d transfers) to <logdir>/trace.json — Chrome trace-event format,
+    # loadable in Perfetto.  Unlike --profile_dir's device trace this
+    # shows the host-side hand-offs, costs a few us per span, and is
+    # bounded: capture stops (with a truncation marker) at the tracer's
+    # 2M-event budget (~200 MB) so long runs can't fill the disk.  The
+    # metrics registry + Prometheus snapshot (<logdir>/metrics.prom) and
+    # the stall attributor are always on; see docs/observability.md.
+    trace: bool = False
 
     # -------------------------------------------------------------------
 

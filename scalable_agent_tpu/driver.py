@@ -35,6 +35,14 @@ from scalable_agent_tpu.envs import (
 from scalable_agent_tpu.envs import dmlab30
 from scalable_agent_tpu.envs.spec import TensorSpec
 from scalable_agent_tpu.models import ImpalaAgent, actor_step, initial_state
+from scalable_agent_tpu.obs import (
+    MetricsWriter,
+    PrometheusExporter,
+    StallAttributor,
+    configure_tracer,
+    get_registry,
+    get_tracer,
+)
 from scalable_agent_tpu.parallel import MeshSpec, make_mesh
 from scalable_agent_tpu.runtime import (
     ActorPool,
@@ -44,7 +52,6 @@ from scalable_agent_tpu.runtime import (
     Trajectory,
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
-from scalable_agent_tpu.runtime.metrics import MetricsWriter
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -364,6 +371,33 @@ def _host_scalar(x) -> float:
     return float(np.asarray(x))
 
 
+def _setup_observability(config: Config, coordinator: bool):
+    """Wire the obs subsystem for one training run: the span tracer
+    (--trace -> <logdir>/trace.json), JAX recompile/memory hooks on the
+    global registry, and the coordinator's Prometheus snapshot file.
+    Returns (registry, prometheus_exporter_or_None)."""
+    if config.trace:
+        # Multi-process runs share logdir; each non-primary process gets
+        # its own file so concurrent writers can't clobber each other
+        # (the Chrome `pid` field keeps them distinguishable if merged).
+        proc = jax.process_index()
+        name = "trace.json" if proc == 0 else f"trace.p{proc}.json"
+        configure_tracer(os.path.join(config.logdir, name))
+    registry = get_registry().install_jax_hooks()
+    prom = (PrometheusExporter(
+        registry, os.path.join(config.logdir, "metrics.prom"))
+        if coordinator else None)
+    return registry, prom
+
+
+def _teardown_observability(config: Config, prom):
+    """Flush the trace tail and the final metrics snapshot."""
+    if config.trace:
+        configure_tracer(None)  # closes (and flushes) the file tracer
+    if prom is not None:
+        prom.dump()
+
+
 def train(config: Config) -> Dict[str, float]:
     """Train until total_environment_frames.  Returns final metrics.
 
@@ -395,82 +429,113 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     if is_coordinator():
         config.save()
-    level_names = training_level_names(config)
-    multi_task = len(level_names) > 1
-    probe_config = (dataclasses.replace(config, level_name=level_names[0])
-                    if multi_task else config)
-    observation_spec, action_space, num_agents = probe_env(probe_config)
-    agent = build_agent(config, action_space)
-
-    learner = build_training_learner(config, agent)
-
-    ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
-                             config.checkpoint_keep)
-    example = zero_trajectory(config, observation_spec, agent)
-    state = learner.init(jax.random.key(config.seed), example)
-    restored = ckpt.restore(target=state)
-    if restored is not None:
-        start_updates, host_state = restored
-        state = learner.place_state(host_state)
-        log.info("restored checkpoint at update %d (%.0f frames)",
-                 start_updates, _host_scalar(state.env_frames))
-    else:
-        start_updates = 0
-
-    env_groups = make_env_groups(config, observation_spec.frame,
-                                 num_agents=num_agents,
-                                 level_names=level_names)
-    pool = ActorPool(agent, env_groups, config.unroll_length,
-                     level_name=config.level_name, seed=config.seed,
-                     inference_mode=config.inference_mode,
-                     observation_spec=observation_spec,
-                     fused_shards=config.accum_fused_shards)
-    pool.set_params(state.params)
-    pool.start()
-
-    # Device prefetch stage: stages the next batch while the current update
-    # runs (the reference's StagingArea +1-step policy lag,
-    # experiment.py:587-597).
-    staged: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+    # Observability comes up BEFORE the actor pool so its threads are
+    # born with the live tracer (spans from the very first unroll); the
+    # try below owns teardown from this point on, so a failure anywhere
+    # in construction still flushes/closes the trace file.
+    registry, prom = _setup_observability(config, is_coordinator())
+    pool = prefetch_thread = writer = ckpt = None
     prefetch_stop = threading.Event()
-    prefetch_thread = start_prefetch(pool, learner, staged, prefetch_stop)
-
-    writer = MetricsWriter(config.logdir) if is_coordinator() else None
-    timing = Timing()
-    updates = start_updates
-    frames_per_update = config.frames_per_update()
-    # The restored TrainState's env_frames (which drives the LR schedule)
-    # is authoritative — recomputing updates*frames_per_update from the
-    # CURRENT config would silently disagree if batch_size/unroll_length/
-    # num_action_repeats changed between runs.
-    frames = _host_scalar(state.env_frames)
-    last_log = time.monotonic()
-    frames_at_last_log = frames
-    metrics = {}
-    completed = False
-    # Multi-task: per-level returns accumulated toward the TRAINING suite
-    # score, cleared after each score like the reference
-    # (experiment.py:652-667).
-    suite_returns: Dict[str, List[float]] = (
-        {name: [] for name in dmlab30.TRAIN_LEVELS} if multi_task else {})
-    # Device-level tracing (SURVEY §5.1): --profile_dir captures a
-    # jax.profiler trace of updates [profile_start_update,
-    # +profile_num_updates) viewable in TensorBoard/XProf — the tool for
-    # locating host↔device stalls the Timing counters can't attribute.
     profiling = False
+    completed = False
+    metrics = {}
     try:
+        level_names = training_level_names(config)
+        multi_task = len(level_names) > 1
+        probe_config = (
+            dataclasses.replace(config, level_name=level_names[0])
+            if multi_task else config)
+        observation_spec, action_space, num_agents = probe_env(
+            probe_config)
+        agent = build_agent(config, action_space)
+
+        learner = build_training_learner(config, agent)
+
+        ckpt = CheckpointManager(config.logdir,
+                                 config.checkpoint_interval_s,
+                                 config.checkpoint_keep)
+        example = zero_trajectory(config, observation_spec, agent)
+        state = learner.init(jax.random.key(config.seed), example)
+        restored = ckpt.restore(target=state)
+        if restored is not None:
+            start_updates, host_state = restored
+            state = learner.place_state(host_state)
+            log.info("restored checkpoint at update %d (%.0f frames)",
+                     start_updates, _host_scalar(state.env_frames))
+        else:
+            start_updates = 0
+
+        env_groups = make_env_groups(config, observation_spec.frame,
+                                     num_agents=num_agents,
+                                     level_names=level_names)
+        pool = ActorPool(agent, env_groups, config.unroll_length,
+                         level_name=config.level_name, seed=config.seed,
+                         inference_mode=config.inference_mode,
+                         observation_spec=observation_spec,
+                         fused_shards=config.accum_fused_shards)
+        pool.set_params(state.params)
+        pool.start()
+
+        # Device prefetch stage: stages the next batch while the current
+        # update runs (the reference's StagingArea +1-step policy lag,
+        # experiment.py:587-597).
+        staged: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+        prefetch_thread = start_prefetch(pool, learner, staged,
+                                         prefetch_stop)
+
+        stall = StallAttributor(registry)
+        actor_steps_counter = registry.counter("actor/agent_steps_total")
+        actor_fps_gauge = registry.gauge(
+            "actor/fps", "env frames/s generated by this host's actors")
+        learner_fps_gauge = registry.gauge(
+            "learner/fps", "env frames/s consumed by the learner")
+        writer = (MetricsWriter(config.logdir, registry=registry)
+                  if is_coordinator() else None)
+        timing = Timing()
+        # Per-interval stage sums for the stall attributor (the display
+        # `timing` keeps moving averages; attribution needs THIS
+        # interval).
+        interval = Timing()
+        actor_steps_at_last_log = actor_steps_counter.value
+        updates = start_updates
+        frames_per_update = config.frames_per_update()
+        # The restored TrainState's env_frames (which drives the LR
+        # schedule) is authoritative — recomputing
+        # updates*frames_per_update from the CURRENT config would
+        # silently disagree if batch_size/unroll_length/
+        # num_action_repeats changed between runs.
+        frames = _host_scalar(state.env_frames)
+        last_log = time.monotonic()
+        frames_at_last_log = frames
+        # Multi-task: per-level returns accumulated toward the TRAINING
+        # suite score, cleared after each score like the reference
+        # (experiment.py:652-667).
+        suite_returns: Dict[str, List[float]] = (
+            {name: [] for name in dmlab30.TRAIN_LEVELS}
+            if multi_task else {})
+        # Device-level tracing (SURVEY §5.1): --profile_dir captures a
+        # jax.profiler trace of updates [profile_start_update,
+        # +profile_num_updates) viewable in TensorBoard/XProf — the tool
+        # for locating host↔device stalls the Timing counters can't
+        # attribute.
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
                     and updates - start_updates
                     == config.profile_start_update):
                 jax.profiler.start_trace(config.profile_dir)
+                # Host spans annotate into the device capture only while
+                # it records (TraceAnnotation is ~100x a span; see
+                # Tracer.set_annotate).
+                get_tracer().set_annotate(True)
                 profiling = True
                 profile_stop_at = updates + config.profile_num_updates
-            with timing.time_avg("wait_batch"):
+            with timing.time_avg("wait_batch"), \
+                    interval.add_time("wait_batch"), \
+                    get_tracer().span("learner/wait_batch", cat="learner"):
                 traj = staged.get()
             if isinstance(traj, Exception):
                 raise traj
-            with timing.time_avg("update"):
+            with timing.time_avg("update"), interval.add_time("update"):
                 state, metrics = learner.update(state, traj)
             pool.set_params(state.params, version=updates)
             updates += 1
@@ -478,6 +543,7 @@ def train(config: Config) -> Dict[str, float]:
             if profiling and updates >= profile_stop_at:
                 jax.block_until_ready(metrics["total_loss"])
                 jax.profiler.stop_trace()
+                get_tracer().set_annotate(False)
                 profiling = False
                 log.info("profiler trace written to %s",
                          config.profile_dir)
@@ -527,14 +593,41 @@ def train(config: Config) -> Dict[str, float]:
                         host_metrics["dmlab30/training_cap_100"])
                     suite_returns = {
                         name: [] for name in dmlab30.TRAIN_LEVELS}
+                # Separate actor-FPS vs learner-FPS: the learner's
+                # consumption rate (`fps`) can hide an actor surplus or
+                # deficit that the queue currently masks.
+                actor_steps = actor_steps_counter.value
+                actor_fps = ((actor_steps - actor_steps_at_last_log)
+                             * config.num_action_repeats / (now - last_log))
+                actor_steps_at_last_log = actor_steps
+                actor_fps_gauge.set(actor_fps)
+                learner_fps_gauge.set(fps)
+                host_metrics["actor_fps"] = actor_fps
+                # Machine-readable timing snapshot (Timing.summary): the
+                # same numbers as the log line, str-parse-free.
+                timing_summary = timing.summary()
+                host_metrics.update(
+                    {f"timing/{k}": v for k, v in timing_summary.items()})
+                # Stall attribution over THIS interval's stage sums.
+                interval_summary = interval.summary()
+                interval.clear()
+                category, evidence = stall.attribute(
+                    interval_summary.get("wait_batch", 0.0),
+                    interval_summary.get("update", 0.0))
                 if writer is not None:
                     writer.write(updates, host_metrics)
+                    writer.write_registry(updates)
+                if prom is not None:
+                    prom.dump()
                 log.info(
-                    "update %d frames %.3g fps %.0f loss %.3f return %s | %s",
-                    updates, frames, fps,
+                    "update %d frames %.3g fps %.0f (actors %.0f) "
+                    "loss %.3f return %s | %s | %s",
+                    updates, frames, fps, actor_fps,
                     host_metrics.get("total_loss", float("nan")),
                     f"{host_metrics.get('episode_return', float('nan')):.2f}",
-                    timing)
+                    " ".join(f"{k} {v:.4f}s"
+                             for k, v in timing_summary.items()),
+                    StallAttributor.describe(category, evidence))
                 last_log, frames_at_last_log = now, frames
             ckpt.maybe_save(updates, state)
         ckpt.maybe_save(updates, state, force=True)
@@ -543,11 +636,17 @@ def train(config: Config) -> Dict[str, float]:
         if profiling:
             jax.profiler.stop_trace()
         prefetch_stop.set()
-        pool.stop()
-        prefetch_thread.join(timeout=5)
+        # Construction may have failed partway — clean up whatever
+        # exists (None-guards), and always flush/close the obs state.
+        if pool is not None:
+            pool.stop()
+        if prefetch_thread is not None:
+            prefetch_thread.join(timeout=5)
         if writer is not None:
             writer.close()
-        ckpt.close()
+        if ckpt is not None:
+            ckpt.close()
+        _teardown_observability(config, prom)
         if completed and jax.process_count() > 1:
             # No process may exit (tearing down the coordination
             # service) until every process finished its checkpoint IO.
@@ -664,7 +763,6 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     else:
         start_updates = 0
 
-    writer = MetricsWriter(config.logdir)
     timing = Timing()
     updates = start_updates
     frames_per_update = config.frames_per_update()
@@ -672,36 +770,51 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     last_log = time.monotonic()
     frames_at_last_log = frames
     metrics = {}
+    # Setup immediately before the try that owns teardown: nothing can
+    # raise in between, so the trace file can't leak.
+    registry, prom = _setup_observability(config, coordinator=True)
     try:
-        while frames < config.total_environment_frames:
-            with timing.time_avg("update"):
-                # The update counter keys the rollout rng
-                # (jax.random.fold_in), so resume continues the exact
-                # action-sampling stream the interrupted run would have
-                # used.
-                state, carry, metrics = trainer.train_step(
-                    state, carry, np.int32(updates))
-            updates += 1
-            frames += frames_per_update
-            now = time.monotonic()
-            if now - last_log >= config.log_interval_s:
-                host_metrics = _finalize_ingraph_metrics(metrics, config)
-                fps = (frames - frames_at_last_log) / (now - last_log)
-                host_metrics["fps"] = fps
-                writer.write(updates, host_metrics)
-                log.info(
-                    "update %d frames %.3g fps %.0f loss %.3f return "
-                    "%s | %s",
-                    updates, frames, fps,
-                    host_metrics.get("total_loss", float("nan")),
-                    f"{host_metrics.get('episode_return', float('nan')):.2f}",
-                    timing)
-                last_log, frames_at_last_log = now, frames
-            ckpt.maybe_save(updates, state)
-        ckpt.maybe_save(updates, state, force=True)
+        # Context-managed writer: the JSONL handle can't leak when the
+        # loop (or checkpointing) raises.
+        with MetricsWriter(config.logdir, registry=registry) as writer:
+            while frames < config.total_environment_frames:
+                with timing.time_avg("update"), \
+                        get_tracer().span("learner/train_step",
+                                          cat="learner"):
+                    # The update counter keys the rollout rng
+                    # (jax.random.fold_in), so resume continues the exact
+                    # action-sampling stream the interrupted run would
+                    # have used.
+                    state, carry, metrics = trainer.train_step(
+                        state, carry, np.int32(updates))
+                updates += 1
+                frames += frames_per_update
+                now = time.monotonic()
+                if now - last_log >= config.log_interval_s:
+                    host_metrics = _finalize_ingraph_metrics(
+                        metrics, config)
+                    fps = (frames - frames_at_last_log) / (now - last_log)
+                    host_metrics["fps"] = fps
+                    timing_summary = timing.summary()
+                    host_metrics.update({f"timing/{k}": v
+                                         for k, v in timing_summary.items()})
+                    writer.write(updates, host_metrics)
+                    if prom is not None:
+                        prom.dump()
+                    log.info(
+                        "update %d frames %.3g fps %.0f loss %.3f return "
+                        "%s | %s",
+                        updates, frames, fps,
+                        host_metrics.get("total_loss", float("nan")),
+                        f"{host_metrics.get('episode_return', float('nan')):.2f}",
+                        " ".join(f"{k} {v:.4f}s"
+                                 for k, v in timing_summary.items()))
+                    last_log, frames_at_last_log = now, frames
+                ckpt.maybe_save(updates, state)
+            ckpt.maybe_save(updates, state, force=True)
     finally:
-        writer.close()
         ckpt.close()
+        _teardown_observability(config, prom)
     return _finalize_ingraph_metrics(metrics, config)
 
 

@@ -1,0 +1,51 @@
+"""Observability subsystem: tracing, metrics registry, stall attribution.
+
+The runtime instruments itself against two process-global singletons —
+``get_tracer()`` (obs/trace.py, Chrome-trace spans, disabled by default
+and near-free when disabled) and ``get_registry()`` (obs/registry.py,
+counters/gauges/histograms, always live).  Exporters (obs/exporters.py)
+turn the registry into Prometheus text exposition and feed the JSONL/
+TensorBoard metrics sink; the stall attributor (obs/stall.py) turns the
+per-interval timings into a named pipeline-bottleneck verdict.
+
+See docs/observability.md for the metric-name schema and workflows.
+"""
+
+from scalable_agent_tpu.obs.exporters import (
+    MetricsWriter,
+    PrometheusExporter,
+    render_prometheus,
+)
+from scalable_agent_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from scalable_agent_tpu.obs.stall import CATEGORIES, StallAttributor
+from scalable_agent_tpu.obs.trace import (
+    Tracer,
+    configure_tracer,
+    get_tracer,
+    load_trace_events,
+    span,
+)
+
+__all__ = [
+    "CATEGORIES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsWriter",
+    "PrometheusExporter",
+    "StallAttributor",
+    "Tracer",
+    "configure_tracer",
+    "get_registry",
+    "get_tracer",
+    "load_trace_events",
+    "render_prometheus",
+    "span",
+]

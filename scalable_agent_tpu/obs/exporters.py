@@ -1,0 +1,169 @@
+"""Exporters: Prometheus text exposition + the scalar JSONL/TensorBoard sink.
+
+Two consumers, one registry (obs/registry.py):
+
+- ``render_prometheus`` / ``PrometheusExporter`` — the standard text
+  exposition format, written as a snapshot file a node-exporter-style
+  textfile collector (or a human) can scrape.  Histograms render as
+  summaries (quantile-labelled series + ``_sum``/``_count``).
+- ``MetricsWriter`` — the training-metrics sink (TensorBoard if
+  tensorboardX is importable, JSONL always), kept API-compatible with
+  the 53-line original (reference metric names — ``episode_return``,
+  ``dmlab30/*`` — pass through unchanged) and rebuilt on the registry:
+  ``write_registry`` appends the registry snapshot to the same streams,
+  so queue gauges and stage latencies land next to the losses.
+"""
+
+import json
+import os
+import re
+import time
+from typing import Dict, Optional
+
+from scalable_agent_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+__all__ = ["MetricsWriter", "PrometheusExporter", "render_prometheus"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "impala_"
+
+
+def _prom_name(name: str) -> str:
+    """Registry names (slash-namespaced, reference-compatible) -> valid
+    Prometheus metric names, uniformly prefixed."""
+    sanitized = _NAME_RE.sub("_", name)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return _PREFIX + sanitized
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(float(value))
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    """Registry -> Prometheus text exposition format (version 0.0.4)."""
+    lines = []
+    for instrument in registry.instruments():
+        name = _prom_name(instrument.name)
+        if instrument.help:
+            lines.append(f"# HELP {name} {instrument.help}")
+        if isinstance(instrument, Counter):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {_fmt(instrument.value)}")
+        elif isinstance(instrument, Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(instrument.value)}")
+        elif isinstance(instrument, Histogram):
+            lines.append(f"# TYPE {name} summary")
+            for q, value in instrument.quantiles().items():
+                lines.append(
+                    f'{name}{{quantile="{q:g}"}} {_fmt(value)}')
+            lines.append(f"{name}_sum {_fmt(instrument.sum)}")
+            lines.append(f"{name}_count {instrument.count}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusExporter:
+    """Snapshot dumper: ``dump()`` atomically rewrites ``path`` with the
+    current exposition text (rename, so a scraper never reads a torn
+    file)."""
+
+    def __init__(self, registry: MetricsRegistry, path: str):
+        self._registry = registry
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def dump(self) -> str:
+        text = render_prometheus(self._registry)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+        return text
+
+
+class MetricsWriter:
+    """Scalar metrics writer: TensorBoard (if available) + JSONL.
+
+    Reference metric names are kept for comparison runs (reference:
+    experiment.py:423-425 learning_rate/total_loss summaries; :643-664
+    per-level episode_return/episode_frames and DMLab-30 human-normalized
+    scores; SF's tensorboardX usage, algorithms/utils/agent.py:195-238).
+
+    A context manager (``with MetricsWriter(logdir) as writer:``) so the
+    JSONL handle can't leak when the training loop raises.
+    """
+
+    def __init__(self, logdir: str, flush_every_s: float = 5.0,
+                 registry: Optional[MetricsRegistry] = None):
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+        self._flush_every_s = flush_every_s
+        self._last_flush = 0.0
+        self._registry = registry
+        try:
+            from tensorboardX import SummaryWriter
+
+            self._tb = SummaryWriter(os.path.join(logdir, "summaries"))
+        except ImportError:
+            self._tb = None
+
+    def write(self, step: int, scalars: Dict[str, float],
+              wall_time: Optional[float] = None):
+        # `is None`, not truthiness: an explicit wall_time=0.0 (epoch
+        # zero in replayed/simulated-clock runs) must be preserved.
+        if wall_time is None:
+            wall_time = time.time()
+        record = {"step": int(step), "time": wall_time}
+        for key, value in scalars.items():
+            value = float(value)
+            record[key] = value
+            if self._tb is not None:
+                self._tb.add_scalar(key, value, global_step=step,
+                                    walltime=wall_time)
+        self._jsonl.write(json.dumps(record) + "\n")
+        now = time.monotonic()
+        if now - self._last_flush > self._flush_every_s:
+            self.flush()
+            self._last_flush = now
+
+    def write_registry(self, step: int,
+                       wall_time: Optional[float] = None,
+                       prefix: str = "obs/"):
+        """Append the registry snapshot (queue gauges, stage latencies,
+        stall verdicts) as one row, namespaced so registry names can
+        never collide with training metric names."""
+        if self._registry is None:
+            return
+        self.write(step,
+                   {prefix + k: v
+                    for k, v in self._registry.snapshot().items()},
+                   wall_time=wall_time)
+
+    def flush(self):
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self):
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False

@@ -1,0 +1,119 @@
+"""Stall attribution: name the pipeline's binding constraint each interval.
+
+IMPALA's design goal is a saturated learner (the decoupled actor->queue->
+learner pipeline exists for exactly that); when throughput falls short,
+the first question is WHERE the time went — the question driver.py's old
+log line ("wait_batch: 0.41s, update: 0.08s") made the operator answer
+by hand.  The attributor classifies each logging interval into one of
+three categories and emits the verdict as both metrics and a log-ready
+string:
+
+- ``device_bound``   — the learner update occupies the interval; the
+  pipeline is healthy and the chip is the constraint.  Fix: faster
+  kernels, bigger mesh, mixed precision.
+- ``env_bound``      — the learner starves (wait_batch dominates) and
+  actor threads spend more time in env simulation than in inference.
+  Fix: more env workers/groups, benchmark_mode, cheaper observations.
+- ``learner_starved`` — the learner starves but env stepping does NOT
+  dominate the actor side: the gap is inference dispatch, host<->device
+  transfer, or queue hand-off.  Fix: inference_mode=accum/accum_fused,
+  larger groups, link tuning (runtime/linktune.py).
+
+Inputs are the driver's per-interval wait/update seconds plus the
+actor-side env/inference histograms the runtime already feeds into the
+registry (the attributor tracks their cumulative sums and differences
+them per interval, so actor threads never synchronize with it).
+"""
+
+from typing import Dict, Optional, Tuple
+
+from scalable_agent_tpu.obs.registry import MetricsRegistry, get_registry
+
+__all__ = ["StallAttributor", "CATEGORIES"]
+
+CATEGORIES = ("device_bound", "env_bound", "learner_starved")
+
+# Actor-side stage histograms the runtime populates (runtime/actor.py,
+# runtime/accum_actor.py).  Sums are cumulative seconds across threads.
+_ENV_HIST = "actor/env_step_s"
+_INFER_HIST = "actor/inference_s"
+
+
+class StallAttributor:
+    """Classify intervals; emit gauges/counters; render report lines."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 starvation_threshold: float = 0.15):
+        self._registry = registry or get_registry()
+        self._threshold = starvation_threshold
+        # Baseline the actor histogram sums NOW: on a (process-global)
+        # registry that already served an earlier run, the first
+        # interval must not be charged with the entire previous run's
+        # cumulative env/inference seconds.
+        self._last_env_sum = self._registry.histogram(_ENV_HIST).sum
+        self._last_infer_sum = self._registry.histogram(_INFER_HIST).sum
+        self._frac_wait = self._registry.gauge(
+            "stall/frac_wait_batch",
+            "fraction of the learner interval spent waiting for a batch")
+        self._frac_update = self._registry.gauge(
+            "stall/frac_update",
+            "fraction of the learner interval spent in the update")
+        self._category_gauges = {
+            name: self._registry.gauge(
+                f"stall/is_{name}",
+                f"1 when the last interval classified as {name}")
+            for name in CATEGORIES
+        }
+        self._category_counters = {
+            name: self._registry.counter(
+                f"stall/intervals_{name}_total",
+                f"intervals classified as {name}")
+            for name in CATEGORIES
+        }
+
+    def _actor_interval(self) -> Tuple[float, float]:
+        """(env_s, infer_s) accumulated since the previous call (or
+        since construction, for the first interval)."""
+        env_sum = self._registry.histogram(_ENV_HIST).sum
+        infer_sum = self._registry.histogram(_INFER_HIST).sum
+        env_d = max(0.0, env_sum - self._last_env_sum)
+        infer_d = max(0.0, infer_sum - self._last_infer_sum)
+        self._last_env_sum, self._last_infer_sum = env_sum, infer_sum
+        return env_d, infer_d
+
+    def attribute(self, wait_batch_s: float, update_s: float
+                  ) -> Tuple[str, Dict[str, float]]:
+        """Classify one interval.  Returns ``(category, fractions)``
+        where fractions carry the evidence for the verdict."""
+        learner_total = wait_batch_s + update_s
+        wait_frac = (wait_batch_s / learner_total) if learner_total else 0.0
+        env_s, infer_s = self._actor_interval()
+        actor_total = env_s + infer_s
+        env_frac = (env_s / actor_total) if actor_total else 0.0
+
+        if wait_frac <= self._threshold:
+            category = "device_bound"
+        elif env_s >= infer_s and actor_total > 0.0:
+            category = "env_bound"
+        else:
+            category = "learner_starved"
+
+        self._frac_wait.set(wait_frac)
+        self._frac_update.set(1.0 - wait_frac if learner_total else 0.0)
+        for name, gauge in self._category_gauges.items():
+            gauge.set(1.0 if name == category else 0.0)
+        self._category_counters[category].inc()
+        return category, {
+            "wait_frac": wait_frac,
+            "actor_env_frac": env_frac,
+            "actor_env_s": env_s,
+            "actor_infer_s": infer_s,
+        }
+
+    @staticmethod
+    def describe(category: str, fractions: Dict[str, float]) -> str:
+        """One log line: verdict + the numbers that justify it."""
+        return (f"pipeline {category} "
+                f"(wait_batch {fractions['wait_frac']:.0%} of learner "
+                f"interval; actor env share "
+                f"{fractions['actor_env_frac']:.0%})")

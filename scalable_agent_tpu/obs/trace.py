@@ -1,0 +1,288 @@
+"""Host-side span tracing with Chrome-trace-event export.
+
+The pipeline's stages live on host threads (actor unrolls, batcher
+consumers, the prefetch stage, the learner loop) where
+``jax.profiler``'s device trace can't see the hand-offs.  A ``Tracer``
+records nested spans per (process, thread) and writes them in the
+Chrome trace-event format — one JSON event per line — which Perfetto
+(https://ui.perfetto.dev) and chrome://tracing load directly.
+
+While a ``--profile_dir`` device capture is recording, the driver flips
+``set_annotate(True)`` so every span also enters a
+``jax.profiler.TraceAnnotation`` of the same name and the profiler
+timeline shows the host spans aligned with the XLA ops they dispatched.
+(Annotations are invisible outside a capture and cost ~100x the span
+itself, so they stay off otherwise.)
+
+Cost discipline: a disabled tracer's ``span()`` returns a shared no-op
+context manager — one call + two no-op dunders, no allocation — so
+instrumented hot loops (per-step actor code) stay well under the <2%
+overhead budget whether or not a trace is being captured
+(bench.py bench_obs measures this every round).
+
+File format: the first line is ``[`` and every event line ends with a
+comma — the Trace Event spec explicitly allows the unclosed array, which
+is what makes the file appendable/crash-safe AND loadable by Perfetto.
+``load_trace_events`` parses it back for tests/tools.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+__all__ = [
+    "Tracer",
+    "configure_tracer",
+    "get_tracer",
+    "load_trace_events",
+    "span",
+]
+
+
+class _NullSpan:
+    """Shared no-op context manager for disabled tracers."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_start_us",
+                 "_annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._annotation = None
+
+    def __enter__(self):
+        tracer = self._tracer
+        if tracer._annotate:
+            try:
+                import jax
+
+                self._annotation = jax.profiler.TraceAnnotation(self._name)
+                self._annotation.__enter__()
+            except Exception:  # profiler unavailable: spans still record
+                tracer._annotate = False
+        self._start_us = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc_info):
+        end_us = time.perf_counter_ns() // 1000
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc_info)
+        self._tracer._complete(
+            self._name, self._cat, self._start_us,
+            end_us - self._start_us, self._args)
+        return False
+
+
+class Tracer:
+    """Collects spans and writes Chrome trace events to ``path``.
+
+    ``span(name)`` spans nest naturally: events on the same (pid, tid)
+    track whose [ts, ts+dur] intervals contain each other render as a
+    stack in Perfetto — no explicit parent ids needed.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 process_name: str = "scalable_agent_tpu",
+                 annotate: bool = False,
+                 flush_every_events: int = 8192,
+                 max_events: int = 2_000_000):
+        self.path = path
+        self.enabled = path is not None
+        self._annotate = annotate and self.enabled
+        self._flush_every = flush_every_events
+        # Hard event budget (~100 bytes/event -> ~200 MB at the
+        # default): per-env-step spans on a multi-hour run would
+        # otherwise grow the file past what Perfetto loads (and fill the
+        # logdir disk).  At exhaustion the tracer writes one truncation
+        # marker and disables itself — the head of the run stays
+        # loadable.
+        self._remaining_events = max_events
+        self._lock = threading.Lock()
+        self._events: List[str] = []  # preformatted JSON event lines
+        self._file = None
+        self._named_tids: Dict[int, str] = {}
+        self._pid = os.getpid()
+        if self.enabled:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._file = open(path, "w")
+            self._file.write("[\n")
+            self._meta("process_name", {"name": process_name})
+
+    def set_annotate(self, flag: bool):
+        """Toggle ``jax.profiler.TraceAnnotation`` wrapping.  An
+        annotation is only visible while a jax profiler capture is
+        recording, and costs ~1-2 orders of magnitude more than the span
+        itself — so the driver flips this on exactly for the
+        ``--profile_dir`` capture window and off again after."""
+        self._annotate = bool(flag) and self.enabled
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, cat: str = "pipeline",
+             args: Optional[dict] = None):
+        """Context manager timing one nested span."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "pipeline",
+                args: Optional[dict] = None):
+        """A zero-duration marker (stall reports, weight publications)."""
+        if not self.enabled:
+            return
+        self._push(json.dumps({
+            "name": name, "ph": "i", "cat": cat, "s": "t",
+            "ts": time.perf_counter_ns() // 1000,
+            "pid": self._pid, "tid": self._tid(), "args": args or {}}))
+
+    def counter(self, name: str, values: Dict[str, float]):
+        """A Chrome counter-track sample (queue depths over time)."""
+        if not self.enabled:
+            return
+        self._push(json.dumps({
+            "name": name, "ph": "C",
+            "ts": time.perf_counter_ns() // 1000,
+            "pid": self._pid, "tid": 0,
+            "args": {k: float(v) for k, v in values.items()}}))
+
+    def _complete(self, name, cat, ts, dur, args):
+        # Hot path: format the event line directly — ~5x cheaper than
+        # dict + json.dumps, and span names/cats are code literals (the
+        # rare quote/backslash falls back to the robust path).
+        if '"' in name or "\\" in name or '"' in cat or "\\" in cat:
+            event = {"name": name, "ph": "X", "cat": cat, "ts": ts,
+                     "dur": dur, "pid": self._pid, "tid": self._tid()}
+            if args:
+                event["args"] = args
+            self._push(json.dumps(event))
+            return
+        suffix = (", \"args\": %s}" % json.dumps(args)) if args else "}"
+        self._push(
+            '{"name": "%s", "ph": "X", "cat": "%s", "ts": %d, '
+            '"dur": %d, "pid": %d, "tid": %d%s'
+            % (name, cat, ts, dur, self._pid, self._tid(), suffix))
+
+    def _tid(self) -> int:
+        tid = threading.get_ident()
+        if tid not in self._named_tids:
+            name = threading.current_thread().name
+            self._named_tids[tid] = name
+            self._meta("thread_name", {"name": name}, tid=tid)
+        return tid
+
+    def _meta(self, name: str, args: dict, tid: int = 0):
+        self._push(json.dumps({"name": name, "ph": "M", "pid": self._pid,
+                               "tid": tid, "args": args}))
+
+    def _push(self, line: str):
+        with self._lock:
+            if self._remaining_events <= 0:
+                return
+            self._remaining_events -= 1
+            self._events.append(line)
+            if self._remaining_events == 0:
+                self._events.append(json.dumps({
+                    "name": "trace_truncated", "ph": "i", "s": "g",
+                    "cat": "pipeline",
+                    "ts": time.perf_counter_ns() // 1000,
+                    "pid": self._pid, "tid": 0,
+                    "args": {"reason": "max_events budget exhausted"}}))
+                # Spans become no-ops from here on; close() still
+                # flushes this tail.
+                self.enabled = False
+                self._annotate = False
+            if len(self._events) >= self._flush_every:
+                self._flush_locked()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _flush_locked(self):
+        if self._file is None or not self._events:
+            self._events.clear()
+            return
+        self._file.write(",\n".join(self._events) + ",\n")
+        self._events.clear()
+        self._file.flush()
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def close(self):
+        with self._lock:
+            self._flush_locked()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self.enabled = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+
+
+# -- module-global tracer ---------------------------------------------------
+# Instrumented runtime modules (actor, batcher, learner, driver) call
+# ``obs.span(...)`` against this singleton; the driver swaps in a real
+# file-backed tracer when --trace is set and restores the null one after.
+
+_tracer = Tracer(path=None)
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def configure_tracer(path: Optional[str], **kwargs) -> Tracer:
+    """Install (and return) the process-global tracer.  ``path=None``
+    restores the disabled tracer; a previous file-backed tracer is
+    closed first so its tail is flushed."""
+    global _tracer
+    with _tracer_lock:
+        old, _tracer = _tracer, Tracer(path=path, **kwargs)
+        # Close on the FILE, not on `enabled`: a tracer that exhausted
+        # its event budget has enabled=False but still holds buffered
+        # events (incl. the truncation marker) and the open handle.
+        if old._file is not None:
+            old.close()
+        return _tracer
+
+
+def span(name: str, cat: str = "pipeline", args: Optional[dict] = None):
+    """``with obs.span('learner/update'):`` against the global tracer."""
+    return _tracer.span(name, cat=cat, args=args)
+
+
+def load_trace_events(path: str) -> Iterator[dict]:
+    """Parse a trace file written by ``Tracer`` (tests and tooling).
+    Tolerates the unclosed-array format and a truncated last line."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a crashed run

@@ -29,6 +29,7 @@ structural path (tests/test_accum_actor.py asserts trajectory
 equivalence), so the learner and V-trace see the same data either way.
 """
 
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.obs import get_tracer
 from scalable_agent_tpu.models.agent import (
     ImpalaAgent,
     actor_step,
@@ -273,6 +275,9 @@ class AccumVectorActor:
         self._bufs = None
         self._core_state = None
         self._last_env_host: Optional[StepOutput] = None
+        from scalable_agent_tpu.runtime.actor import actor_stage_histograms
+
+        self._h_env, self._h_infer = actor_stage_histograms()
 
     @staticmethod
     def _flat_frame(env_output: StepOutput) -> np.ndarray:
@@ -294,16 +299,26 @@ class AccumVectorActor:
             c=self._core_state.c, h=self._core_state.h)
         core_state = self._core_state
         bufs = self._bufs
+        tracer = get_tracer()
         for slot in range(1, p.unroll_length + 1):
             self._counter += 1
-            frame_flat, packed, extras = self._upload(self._last_env_host)
-            action_dev, core_state, bufs = p.step(
-                params, self._seed, np.int32(self._counter),
-                np.int32(slot), frame_flat, bufs, packed, extras,
-                core_state)
-            actions = np.asarray(action_dev)  # the ONLY per-step fetch
-            self._envs.step_send(actions)
-            self._last_env_host = self._envs.step_recv()
+            t0 = time.perf_counter()
+            # Inference = upload + dispatch + the blocking action fetch
+            # (the single per-step host<->device round trip).
+            with tracer.span("actor/inference", cat="actor"):
+                frame_flat, packed, extras = self._upload(
+                    self._last_env_host)
+                action_dev, core_state, bufs = p.step(
+                    params, self._seed, np.int32(self._counter),
+                    np.int32(slot), frame_flat, bufs, packed, extras,
+                    core_state)
+                actions = np.asarray(action_dev)  # the ONLY per-step fetch
+            t1 = time.perf_counter()
+            with tracer.span("actor/env_step", cat="actor"):
+                self._envs.step_send(actions)
+                self._last_env_host = self._envs.step_recv()
+            self._h_infer.observe(t1 - t0)
+            self._h_env.observe(time.perf_counter() - t1)
 
         traj, self._bufs = p.finish(*self._upload(self._last_env_host),
                                     bufs)
@@ -369,6 +384,9 @@ class GroupedAccumActor:
         self._bufs = None
         self._core = None  # AgentState with [k, B, H] leaves
         self._last_outs = None  # k host StepOutputs
+        from scalable_agent_tpu.runtime.actor import actor_stage_histograms
+
+        self._h_env, self._h_infer = actor_stage_histograms()
 
         # One fused program per phase, vmapped over the group axis.
         # params/counter/slot are shared (in_axes None): lockstep means
@@ -402,17 +420,26 @@ class GroupedAccumActor:
 
         first_core = self._core
         core, bufs = self._core, self._bufs
+        tracer = get_tracer()
         for slot in range(1, p.unroll_length + 1):
             self._counter += 1
-            frames, packeds, extras = self._stacked_upload()
-            actions_dev, core, bufs = self.step(
-                params, self._seeds, np.int32(self._counter),
-                np.int32(slot), frames, bufs, packeds, extras, core)
-            actions = np.asarray(actions_dev)  # ONE fetch for ALL groups
-            for envs, group_actions in zip(self.envs_list, actions):
-                envs.step_send(group_actions)
-            self._last_outs = [envs.step_recv()
-                               for envs in self.envs_list]
+            t0 = time.perf_counter()
+            with tracer.span("actor/inference", cat="actor",
+                             args={"groups": k}):
+                frames, packeds, extras = self._stacked_upload()
+                actions_dev, core, bufs = self.step(
+                    params, self._seeds, np.int32(self._counter),
+                    np.int32(slot), frames, bufs, packeds, extras, core)
+                # ONE fetch for ALL groups
+                actions = np.asarray(actions_dev)
+            t1 = time.perf_counter()
+            with tracer.span("actor/env_step", cat="actor"):
+                for envs, group_actions in zip(self.envs_list, actions):
+                    envs.step_send(group_actions)
+                self._last_outs = [envs.step_recv()
+                                   for envs in self.envs_list]
+            self._h_infer.observe(t1 - t0)
+            self._h_env.observe(time.perf_counter() - t1)
 
         traj, self._bufs = self.finish(*self._stacked_upload(), bufs)
         self._core = core

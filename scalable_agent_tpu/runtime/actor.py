@@ -27,6 +27,7 @@ a host-runtime world:
 import functools
 import queue as queue_lib
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -35,12 +36,29 @@ import numpy as np
 
 from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step, initial_state
 from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.obs import get_registry, get_tracer
 from scalable_agent_tpu.types import (
     ActorOutput,
     AgentOutput,
     AgentState,
     map_structure,
 )
+
+
+def actor_stage_histograms(registry=None):
+    """The shared per-step stage histograms every actor flavour feeds
+    (and the stall attributor reads): (env_step_s, inference_s).  One
+    registration point so the metric names can't drift apart across
+    VectorActor / AccumVectorActor / GroupedAccumActor."""
+    registry = registry or get_registry()
+    return (
+        registry.histogram(
+            "actor/env_step_s",
+            "seconds per vectorized env step (send+recv)"),
+        registry.histogram(
+            "actor/inference_s",
+            "seconds per batched inference step (dispatch+fetch)"),
+    )
 
 
 def _to_numpy(tree):
@@ -94,6 +112,7 @@ class VectorActor:
         self._last_env_output = None
         self._last_agent_output = None
         self._core_state = None
+        self._h_env, self._h_infer = actor_stage_histograms()
 
     def _bootstrap(self, params):
         """First-ever unroll: create the initial carried entries.
@@ -124,16 +143,24 @@ class VectorActor:
         env_output = self._last_env_output
         agent_output = self._last_agent_output
         core_state = self._core_state
+        tracer = get_tracer()
         for _ in range(self._unroll_length):
             self._step_count += 1
             rng = jax.random.fold_in(self._rng, self._step_count)
-            out, core_state = self._actor_step(
-                params, rng, agent_output.action, env_output, core_state)
-            agent_output = _to_numpy(out)
+            t0 = time.perf_counter()
+            with tracer.span("actor/inference", cat="actor"):
+                out, core_state = self._actor_step(
+                    params, rng, agent_output.action, env_output,
+                    core_state)
+                agent_output = _to_numpy(out)
+            t1 = time.perf_counter()
             # Dispatch env steps, then wait — device work for other groups
             # can run while this thread blocks on the pipes.
-            self._envs.step_send(agent_output.action)
-            env_output = self._envs.step_recv()
+            with tracer.span("actor/env_step", cat="actor"):
+                self._envs.step_send(agent_output.action)
+                env_output = self._envs.step_recv()
+            self._h_infer.observe(t1 - t0)
+            self._h_env.observe(time.perf_counter() - t1)
             env_entries.append(env_output)
             agent_entries.append(agent_output)
 
@@ -316,6 +343,39 @@ class ActorPool:
         self._threads = []
         self._errors = []
 
+        # Observability: trajectory-queue gauges sample by callback
+        # (nothing on the hot path); the frames counter gives actor-side
+        # FPS independently of the learner's consumption rate.  The
+        # callbacks hold only WEAK references — the process-global
+        # registry must never keep a finished pool (and the trajectories
+        # buffered in its queue) alive.
+        import weakref
+
+        registry = get_registry()
+        queue_ref = weakref.ref(self.queue)
+        registry.gauge(
+            "actor_pool/queue_depth",
+            "trajectories staged for the learner",
+            fn=lambda: (q.qsize() if (q := queue_ref()) is not None
+                        else 0.0))
+        registry.gauge(
+            "actor_pool/queue_capacity",
+            "trajectory queue bound").set(self.queue.maxsize)
+        pool_ref = weakref.ref(self)
+        registry.gauge(
+            "actor_pool/params_version",
+            "newest published weight snapshot",
+            fn=lambda: (p._params_version if (p := pool_ref()) is not None
+                        else 0.0))
+        self._frames_counter = registry.counter(
+            "actor/agent_steps_total",
+            "agent steps generated across all groups (x action repeats "
+            "= env frames)")
+        self._trajectories_counter = registry.counter(
+            "actor/trajectories_total", "unrolls handed to the queue")
+        self._frames_per_trajectory = unroll_length * (
+            env_groups[0].num_envs if env_groups else 0)
+
     # -- service-mode plumbing ---------------------------------------------
 
     def _service_request(self, params, rng, action, env_output, state):
@@ -425,18 +485,29 @@ class ActorPool:
     def _actor_loop(self, actor: VectorActor):
         try:
             while not self._stop.is_set():
+                # Re-read the global tracer each unroll: the driver may
+                # enable tracing after this thread was born.
+                tracer = get_tracer()
                 params = self._get_params()
-                result = actor.run_unroll(params)
+                with tracer.span("actor/unroll", cat="actor"):
+                    result = actor.run_unroll(params)
                 # Grouped (co-dispatch) actors emit one trajectory per
                 # group per lockstep unroll.
                 items = result if isinstance(result, list) else [result]
                 for trajectory in items:
-                    while not self._stop.is_set():
-                        try:
-                            self.queue.put(trajectory, timeout=0.1)
-                            break
-                        except queue_lib.Full:
-                            continue
+                    delivered = False
+                    with tracer.span("batcher/queue_put", cat="queue"):
+                        while not self._stop.is_set():
+                            try:
+                                self.queue.put(trajectory, timeout=0.1)
+                                delivered = True
+                                break
+                            except queue_lib.Full:
+                                continue
+                    if delivered:  # shutdown can abandon the put
+                        self._trajectories_counter.inc()
+                        self._frames_counter.inc(
+                            self._frames_per_trajectory)
         except Exception as exc:  # surface in get_trajectory
             if self._stop.is_set():
                 return  # shutdown cascade (e.g. batcher closed) — benign
@@ -454,7 +525,8 @@ class ActorPool:
         return self
 
     def get_trajectory(self, timeout: Optional[float] = None) -> ActorOutput:
-        item = self.queue.get(timeout=timeout)
+        with get_tracer().span("batcher/queue_get", cat="queue"):
+            item = self.queue.get(timeout=timeout)
         if isinstance(item, Exception):
             raise item
         return item

@@ -29,12 +29,14 @@ dynamic_batching.py:125-128).
 
 import itertools
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from scalable_agent_tpu.obs import get_registry, get_tracer
 from scalable_agent_tpu.types import map_structure
 
 
@@ -43,11 +45,12 @@ class BatcherClosedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("sample", "future")
+    __slots__ = ("sample", "future", "enqueued_at")
 
     def __init__(self, sample):
         self.sample = sample
         self.future = Future()
+        self.enqueued_at = time.monotonic()
 
 
 class DynamicBatcher:
@@ -71,6 +74,8 @@ class DynamicBatcher:
         timeout_ms: Optional[float] = 100.0,
         pad_to_sizes: Optional[Sequence[int]] = None,
         num_consumers: int = 1,
+        metrics_name: str = "batcher",
+        registry=None,
     ):
         if minimum_batch_size > maximum_batch_size:
             raise ValueError("minimum_batch_size > maximum_batch_size")
@@ -91,6 +96,30 @@ class DynamicBatcher:
         self._closed = False
         self._batch_ids = itertools.count()
 
+        # Observability: queue depth is sampled by callback at snapshot
+        # time (zero hot-path cost); batch shape/latency histograms are
+        # fed once per formed batch.  ``metrics_name`` disambiguates
+        # coexisting batchers in one process.  Weak reference only: the
+        # global registry must not keep a closed batcher alive.
+        import weakref
+
+        registry = registry or get_registry()
+        pending_ref = weakref.ref(self._pending)
+        registry.gauge(
+            f"{metrics_name}/queue_depth", "requests awaiting a batch",
+            fn=lambda: (len(p) if (p := pending_ref()) is not None
+                        else 0.0))
+        self._batch_size_hist = registry.histogram(
+            f"{metrics_name}/batch_size", "valid rows per formed batch")
+        self._occupancy_hist = registry.histogram(
+            f"{metrics_name}/occupancy",
+            "valid rows / maximum_batch_size per formed batch")
+        self._latency_hist = registry.histogram(
+            f"{metrics_name}/request_latency_s",
+            "enqueue -> result seconds per request")
+        self._batches_total = registry.counter(
+            f"{metrics_name}/batches_total", "batches executed")
+
         self._consumers = [
             threading.Thread(target=self._consume_loop, daemon=True,
                              name=f"batcher-consumer-{i}")
@@ -106,12 +135,13 @@ class DynamicBatcher:
         return self.compute_async(sample).result()
 
     def compute_async(self, sample) -> Future:
-        with self._lock:
-            if self._closed:
-                raise BatcherClosedError("batcher is closed")
-            request = _Request(sample)
-            self._pending.append(request)
-            self._nonempty.notify()
+        with get_tracer().span("batcher/enqueue"):
+            with self._lock:
+                if self._closed:
+                    raise BatcherClosedError("batcher is closed")
+                request = _Request(sample)
+                self._pending.append(request)
+                self._nonempty.notify()
         return request.future
 
     # -- consumer side -----------------------------------------------------
@@ -165,13 +195,20 @@ class DynamicBatcher:
     def _run_batch(self, batch):
         n = len(batch)
         padded = self._pad_rows(n)
+        self._batch_size_hist.observe(n)
+        self._occupancy_hist.observe(n / self._max)
+        self._batches_total.inc()
         try:
-            stacked = map_structure(
-                lambda *rows: _stack_padded(rows, padded),
-                *[r.sample for r in batch])
-            result = self._compute_fn(stacked, n)
-            rows = _unstack(result, n)
+            with get_tracer().span("batcher/run_batch",
+                                   args={"n": n, "padded": padded}):
+                stacked = map_structure(
+                    lambda *rows: _stack_padded(rows, padded),
+                    *[r.sample for r in batch])
+                result = self._compute_fn(stacked, n)
+                rows = _unstack(result, n)
+            done_at = time.monotonic()
             for request, row in zip(batch, rows):
+                self._latency_hist.observe(done_at - request.enqueued_at)
                 request.future.set_result(row)
         except BaseException as exc:  # propagate to all callers in batch
             for request in batch:

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from scalable_agent_tpu.obs import get_registry, get_tracer
 from scalable_agent_tpu.runtime.learner import TrainState
 
 
@@ -88,16 +89,23 @@ class CheckpointManager:
                 np.asarray(decision)))
         if not decision:
             return False
-        host_state = jax.tree_util.tree_map(_to_host, state)
-        if self._manager is not None:
-            self._manager.save(
-                step, args=ocp.args.StandardSave(host_state))
-            if jax.process_count() > 1:
-                # Complete the write before any peer can race ahead to
-                # process exit — a departing peer tears down the
-                # coordination service and cancels in-flight async
-                # writes on the primary.
-                self._manager.wait_until_finished()
+        registry = get_registry()
+        with get_tracer().span("checkpoint/save", cat="checkpoint"), \
+                registry.histogram(
+                    "checkpoint/save_s",
+                    "state fetch + orbax write seconds").time():
+            host_state = jax.tree_util.tree_map(_to_host, state)
+            if self._manager is not None:
+                self._manager.save(
+                    step, args=ocp.args.StandardSave(host_state))
+                if jax.process_count() > 1:
+                    # Complete the write before any peer can race ahead
+                    # to process exit — a departing peer tears down the
+                    # coordination service and cancels in-flight async
+                    # writes on the primary.
+                    self._manager.wait_until_finished()
+        registry.counter("checkpoint/saves_total",
+                         "checkpoints written").inc()
         self._last_save = now
         return True
 

@@ -33,6 +33,7 @@ import numpy as np
 import optax
 
 from scalable_agent_tpu.models.agent import ImpalaAgent
+from scalable_agent_tpu.obs import get_registry, get_tracer
 from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
 from scalable_agent_tpu.parallel.mesh import (
@@ -184,6 +185,15 @@ class Learner:
         self._update = jax.jit(self._update_impl, donate_argnums=(0,))
         self._replicated = replicated
         self._traj_shardings = traj_shardings
+        registry = get_registry()
+        self._h_put = registry.histogram(
+            "learner/put_trajectory_s",
+            "host->device trajectory placement seconds")
+        self._updates_counter = registry.counter(
+            "learner/updates_total", "update steps dispatched")
+        self._frames_counter = registry.counter(
+            "learner/env_frames_total",
+            "env frames consumed by dispatched updates")
 
     @property
     def mesh(self):
@@ -236,6 +246,11 @@ class Learner:
         the data axis spans hosts (DCN) exactly like the reference's
         actors feeding one learner queue over gRPC
         (reference: experiment.py:531,556-562)."""
+        with get_tracer().span("learner/put_trajectory", cat="h2d"), \
+                self._h_put.time():
+            return self._put_trajectory(trajectory)
+
+    def _put_trajectory(self, trajectory: Trajectory) -> Trajectory:
         if jax.process_count() > 1:
             def build(sharding, local):
                 return jax.make_array_from_process_local_data(
@@ -344,4 +359,8 @@ class Learner:
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         """One training step.  ``trajectory`` should already be on device
         (``put_trajectory``) for best overlap; host batches also work."""
-        return self._update(state, trajectory)
+        with get_tracer().span("learner/update", cat="learner"):
+            out = self._update(state, trajectory)
+        self._updates_counter.inc()
+        self._frames_counter.inc(self._frames_per_update)
+        return out

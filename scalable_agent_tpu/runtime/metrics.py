@@ -1,53 +1,8 @@
-"""Scalar metrics writer: TensorBoard (if available) + JSONL.
+"""Back-compat shim: the metrics writer moved into the observability
+package (obs/exporters.py), rebuilt on the metrics registry.  Existing
+imports (``from scalable_agent_tpu.runtime.metrics import MetricsWriter``)
+keep working."""
 
-Reference metric names are kept for comparison runs (reference:
-experiment.py:423-425 learning_rate/total_loss summaries; :643-664
-per-level episode_return/episode_frames and DMLab-30 human-normalized
-scores; SF's tensorboardX usage, algorithms/utils/agent.py:195-238).
-"""
+from scalable_agent_tpu.obs.exporters import MetricsWriter
 
-import json
-import os
-import time
-from typing import Dict, Optional
-
-
-class MetricsWriter:
-    def __init__(self, logdir: str, flush_every_s: float = 5.0):
-        os.makedirs(logdir, exist_ok=True)
-        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
-        self._flush_every_s = flush_every_s
-        self._last_flush = 0.0
-        try:
-            from tensorboardX import SummaryWriter
-
-            self._tb = SummaryWriter(os.path.join(logdir, "summaries"))
-        except ImportError:
-            self._tb = None
-
-    def write(self, step: int, scalars: Dict[str, float],
-              wall_time: Optional[float] = None):
-        wall_time = wall_time or time.time()
-        record = {"step": int(step), "time": wall_time}
-        for key, value in scalars.items():
-            value = float(value)
-            record[key] = value
-            if self._tb is not None:
-                self._tb.add_scalar(key, value, global_step=step,
-                                    walltime=wall_time)
-        self._jsonl.write(json.dumps(record) + "\n")
-        now = time.monotonic()
-        if now - self._last_flush > self._flush_every_s:
-            self.flush()
-            self._last_flush = now
-
-    def flush(self):
-        self._jsonl.flush()
-        if self._tb is not None:
-            self._tb.flush()
-
-    def close(self):
-        self.flush()
-        self._jsonl.close()
-        if self._tb is not None:
-            self._tb.close()
+__all__ = ["MetricsWriter"]

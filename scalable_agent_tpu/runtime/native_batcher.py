@@ -15,11 +15,13 @@ subgraph in the reference (dynamic_batching.py:131-144).
 
 import ctypes
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from scalable_agent_tpu.native import load_library
+from scalable_agent_tpu.obs import get_registry, get_tracer
 from scalable_agent_tpu.runtime.batcher import BatcherClosedError
 from scalable_agent_tpu.types import map_structure
 
@@ -135,6 +137,8 @@ class NativeBatcher:
         pad_to_sizes: Optional[Sequence[int]] = None,
         num_consumers: int = 1,
         variant: str = "opt",
+        metrics_name: str = "native_batcher",
+        registry=None,
     ):
         if minimum_batch_size > maximum_batch_size:
             raise ValueError("minimum_batch_size > maximum_batch_size")
@@ -143,6 +147,31 @@ class NativeBatcher:
             if pad_to_sizes[-1] < maximum_batch_size:
                 raise ValueError(
                     "largest pad_to_sizes must cover maximum_batch_size")
+        # The pending queue lives in C++; in-flight callers (entered
+        # compute(), result not yet unpacked) are the Python-visible
+        # depth proxy the gauge samples.  Weak reference only: the
+        # global registry must not keep a closed batcher alive.
+        import weakref
+
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        registry = registry or get_registry()
+        self_ref = weakref.ref(self)
+        registry.gauge(
+            f"{metrics_name}/queue_depth",
+            "callers blocked in the native batcher",
+            fn=lambda: (b._inflight if (b := self_ref()) is not None
+                        else 0.0))
+        self._batch_size_hist = registry.histogram(
+            f"{metrics_name}/batch_size", "valid rows per formed batch")
+        self._occupancy_hist = registry.histogram(
+            f"{metrics_name}/occupancy",
+            "valid rows / maximum_batch_size per formed batch")
+        self._latency_hist = registry.histogram(
+            f"{metrics_name}/request_latency_s",
+            "enqueue -> result seconds per request")
+        self._batches_total = registry.counter(
+            f"{metrics_name}/batches_total", "batches executed")
         self._lib = load_library(variant)
         self._compute_fn = compute_fn
         self._sample_layout = _Layout(example_sample)
@@ -168,14 +197,23 @@ class NativeBatcher:
     def compute(self, sample):
         if self._closed:
             raise BatcherClosedError("batcher is closed")
-        sample_buf = bytearray(self._sample_layout.nbytes)
-        self._sample_layout.pack_into(memoryview(sample_buf), sample)
-        result_buf = bytearray(self._result_layout.nbytes)
-        sample_c = (ctypes.c_char * len(sample_buf)).from_buffer(sample_buf)
-        result_c = (ctypes.c_char * len(result_buf)).from_buffer(result_buf)
-        status = self._lib.batcher_compute(
-            self._handle, ctypes.addressof(sample_c),
-            ctypes.addressof(result_c))
+        t0 = time.monotonic()
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            sample_buf = bytearray(self._sample_layout.nbytes)
+            self._sample_layout.pack_into(memoryview(sample_buf), sample)
+            result_buf = bytearray(self._result_layout.nbytes)
+            sample_c = (ctypes.c_char * len(sample_buf)).from_buffer(
+                sample_buf)
+            result_c = (ctypes.c_char * len(result_buf)).from_buffer(
+                result_buf)
+            status = self._lib.batcher_compute(
+                self._handle, ctypes.addressof(sample_c),
+                ctypes.addressof(result_c))
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
         if status == _CLOSED:
             raise BatcherClosedError(
                 "batcher closed while request pending")
@@ -183,6 +221,7 @@ class NativeBatcher:
             error = self._compute_error or RuntimeError(
                 f"native batcher error status {status}")
             raise error
+        self._latency_hist.observe(time.monotonic() - t0)
         return self._result_layout.unpack_one(memoryview(result_buf))
 
     # -- consumer side -----------------------------------------------------
@@ -209,18 +248,24 @@ class NativeBatcher:
                 return
             n = n_c.value
             try:
-                batched = self._sample_layout.unpack_rows(
-                    memoryview(batch_buf), n)
-                padded = self._pad_rows(n)
-                if padded > n:
-                    batched = map_structure(
-                        lambda x: None if x is None else np.pad(
-                            x, [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)),
-                        batched)
-                result = self._compute_fn(batched, n)
-                result_buf = bytearray(n * self._result_layout.nbytes)
-                self._result_layout.pack_rows(
-                    memoryview(result_buf), result, n)
+                self._batch_size_hist.observe(n)
+                self._occupancy_hist.observe(n / self._max)
+                self._batches_total.inc()
+                with get_tracer().span("batcher/native_run_batch",
+                                       args={"n": n}):
+                    batched = self._sample_layout.unpack_rows(
+                        memoryview(batch_buf), n)
+                    padded = self._pad_rows(n)
+                    if padded > n:
+                        batched = map_structure(
+                            lambda x: None if x is None else np.pad(
+                                x,
+                                [(0, padded - n)] + [(0, 0)] * (x.ndim - 1)),
+                            batched)
+                    result = self._compute_fn(batched, n)
+                    result_buf = bytearray(n * self._result_layout.nbytes)
+                    self._result_layout.pack_rows(
+                        memoryview(result_buf), result, n)
                 result_c = (ctypes.c_char * len(result_buf)).from_buffer(
                     result_buf)
                 self._lib.batcher_set_results(

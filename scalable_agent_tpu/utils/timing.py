@@ -2,6 +2,7 @@
 
 import time
 from collections import deque
+from typing import Dict
 
 
 class AvgTime:
@@ -57,6 +58,17 @@ class Timing(dict):
 
     def time_avg(self, key: str):
         return _TimingContext(self, key, "avg")
+
+    def summary(self) -> Dict[str, float]:
+        """Flat ``{key: seconds}`` snapshot, ``AvgTime`` entries
+        unwrapped to their moving average — the machine-readable twin of
+        ``__str__`` so timings feed the metrics registry and
+        ``MetricsWriter`` without string parsing."""
+        return {
+            key: value.value if isinstance(value, AvgTime)
+            else float(value)
+            for key, value in self.items()
+        }
 
     def __str__(self):
         parts = []

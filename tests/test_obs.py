@@ -1,0 +1,428 @@
+"""Unit tests for the observability subsystem (obs/)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu import obs
+from scalable_agent_tpu.obs import (
+    MetricsRegistry,
+    MetricsWriter,
+    StallAttributor,
+    Tracer,
+    load_trace_events,
+    render_prometheus,
+)
+from scalable_agent_tpu.runtime.batcher import DynamicBatcher
+from scalable_agent_tpu.utils import Timing
+
+
+class TestTracer:
+    def test_span_nesting_and_ordering(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        with Tracer(path, annotate=False) as tracer:
+            with tracer.span("outer", cat="test"):
+                time.sleep(0.001)
+                with tracer.span("inner", cat="test"):
+                    time.sleep(0.001)
+                time.sleep(0.001)
+        events = list(load_trace_events(path))
+        complete = {e["name"]: e for e in events if e["ph"] == "X"}
+        assert set(complete) == {"outer", "inner"}
+        outer, inner = complete["outer"], complete["inner"]
+        # Same process/thread track; nesting expressed by containment.
+        assert outer["pid"] == inner["pid"] == os.getpid()
+        assert outer["tid"] == inner["tid"]
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert inner["dur"] < outer["dur"]
+        # The inner span exits (and is therefore emitted) first.
+        names = [e["name"] for e in events if e["ph"] == "X"]
+        assert names == ["inner", "outer"]
+
+    def test_metadata_and_instant_and_counter_events(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        with Tracer(path, annotate=False,
+                    process_name="test_proc") as tracer:
+            with tracer.span("s"):
+                pass
+            tracer.instant("mark", args={"k": 1})
+            tracer.counter("queues", {"depth": 3})
+        events = list(load_trace_events(path))
+        metas = [e for e in events if e["ph"] == "M"]
+        assert any(e["name"] == "process_name"
+                   and e["args"]["name"] == "test_proc" for e in metas)
+        assert any(e["name"] == "thread_name" for e in metas)
+        assert any(e["ph"] == "i" and e["name"] == "mark" for e in events)
+        counter = next(e for e in events if e["ph"] == "C")
+        assert counter["args"] == {"depth": 3.0}
+
+    def test_file_is_perfetto_loadable_json_array(self, tmp_path):
+        """The unclosed-array trace becomes strict JSON by appending a
+        terminator — the format Perfetto/chrome://tracing parse."""
+        path = str(tmp_path / "trace.json")
+        with Tracer(path, annotate=False) as tracer:
+            with tracer.span("a"):
+                pass
+        text = open(path).read()
+        assert text.startswith("[\n")
+        events = json.loads(text.rstrip().rstrip(",") + "]")
+        assert isinstance(events, list) and events
+
+    def test_disabled_tracer_is_noop_and_allocation_free(self, tmp_path):
+        tracer = Tracer(path=None)
+        span_a = tracer.span("x")
+        span_b = tracer.span("y")
+        assert span_a is span_b  # the shared null singleton
+        with span_a:
+            pass
+        tracer.instant("m")
+        tracer.counter("c", {"v": 1})
+        tracer.close()
+
+    def test_concurrent_spans_keep_per_thread_tracks(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tracer = Tracer(path, annotate=False)
+        # All 4 threads must be alive simultaneously: the OS recycles
+        # thread idents, so a sequential finish could alias tids.
+        barrier = threading.Barrier(4)
+
+        def worker():
+            barrier.wait(timeout=10)
+            for _ in range(20):
+                with tracer.span("w"):
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tracer.close()
+        events = [e for e in load_trace_events(path) if e["ph"] == "X"]
+        assert len(events) == 80
+        assert len({e["tid"] for e in events}) == 4
+
+    def test_event_budget_truncates_with_marker(self, tmp_path):
+        """The max_events budget stops capture (disk/Perfetto bound) but
+        leaves a loadable file whose tail names the truncation."""
+        path = str(tmp_path / "trace.json")
+        tracer = obs.configure_tracer(path, annotate=False, max_events=5)
+        for _ in range(20):
+            with tracer.span("s"):
+                pass
+        assert not tracer.enabled  # budget exhausted -> capture off
+        # The teardown path must still flush the tail even though the
+        # budget already flipped enabled off (regression: the swap used
+        # to gate close() on `enabled` and leaked the buffered marker).
+        obs.configure_tracer(None)
+        assert tracer._file is None  # really closed
+        events = list(load_trace_events(path))
+        assert sum(1 for e in events if e["ph"] == "X") <= 5
+        assert events[-1]["name"] == "trace_truncated"
+
+    def test_global_configure_roundtrip(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tracer = obs.configure_tracer(path, annotate=False)
+        assert obs.get_tracer() is tracer
+        with obs.span("global_span"):
+            pass
+        obs.configure_tracer(None)  # closes + flushes the file tracer
+        assert not obs.get_tracer().enabled
+        names = [e["name"] for e in load_trace_events(path)
+                 if e["ph"] == "X"]
+        assert names == ["global_span"]
+
+
+class TestHistogram:
+    def test_percentiles_match_numpy(self):
+        rng = np.random.RandomState(7)
+        samples = rng.lognormal(size=500)
+        registry = MetricsRegistry()
+        hist = registry.histogram("lat", window=1000)
+        for s in samples:
+            hist.observe(float(s))
+        quantiles = hist.quantiles()
+        for q in (0.5, 0.95, 0.99):
+            np.testing.assert_allclose(
+                quantiles[q], np.percentile(samples, q * 100), rtol=1e-12)
+        assert hist.count == 500
+        np.testing.assert_allclose(hist.sum, samples.sum(), rtol=1e-9)
+
+    def test_window_bounds_quantiles_but_not_count(self):
+        registry = MetricsRegistry()
+        hist = registry.histogram("lat", window=10)
+        for v in range(100):
+            hist.observe(float(v))
+        assert hist.count == 100  # exact lifetime count
+        # Quantiles only see the last 10 observations (90..99).
+        assert hist.quantiles()[0.5] == pytest.approx(
+            np.percentile(np.arange(90, 100), 50))
+
+    def test_timer_context(self):
+        registry = MetricsRegistry()
+        hist = registry.histogram("t")
+        with hist.time():
+            time.sleep(0.005)
+        assert hist.count == 1
+        assert hist.sum >= 0.004
+
+
+class TestRegistry:
+    def test_idempotent_registration(self):
+        registry = MetricsRegistry()
+        assert registry.counter("a") is registry.counter("a")
+        with pytest.raises(ValueError, match="already registered"):
+            registry.gauge("a")
+
+    def test_counter_monotonic(self):
+        counter = MetricsRegistry().counter("c")
+        counter.inc()
+        counter.inc(2.5)
+        assert counter.value == 3.5
+        with pytest.raises(ValueError):
+            counter.inc(-1)
+
+    def test_callback_gauge_sampled_at_snapshot(self):
+        registry = MetricsRegistry()
+        box = {"v": 1.0}
+        registry.gauge("g", fn=lambda: box["v"])
+        assert registry.snapshot()["g"] == 1.0
+        box["v"] = 9.0
+        assert registry.snapshot()["g"] == 9.0
+
+    def test_failing_gauge_callback_reads_nan(self):
+        registry = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("queue died")
+
+        registry.gauge("g", fn=boom)
+        assert np.isnan(registry.snapshot()["g"])
+
+    def test_jax_compile_hooks_count_recompilations(self):
+        import jax
+
+        registry = MetricsRegistry().install_jax_hooks()
+        before = registry.counter("jax/compile_count").value
+        jax.jit(lambda x: x * 3.14159 + 2.71828)(np.float32(1.0))
+        after = registry.counter("jax/compile_count").value
+        assert after > before
+        assert registry.counter("jax/compile_time_s").value > 0.0
+
+
+class TestQueueDepthGauge:
+    def test_depth_under_partial_fill_and_drain(self):
+        registry = MetricsRegistry()
+        batcher = DynamicBatcher(
+            lambda tree, n: tree, minimum_batch_size=4,
+            timeout_ms=None, metrics_name="qtest", registry=registry)
+        try:
+            futures = [batcher.compute_async(np.zeros(2, np.float32))
+                       for _ in range(3)]
+            # Below minimum_batch_size: requests sit in the queue.
+            assert registry.snapshot()["qtest/queue_depth"] == 3.0
+            futures.append(batcher.compute_async(np.zeros(2, np.float32)))
+            for f in futures:
+                f.result(timeout=5)
+            deadline = time.monotonic() + 5
+            while (registry.snapshot()["qtest/queue_depth"] > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert registry.snapshot()["qtest/queue_depth"] == 0.0
+            assert registry.snapshot()["qtest/batch_size/sum"] == 4.0
+        finally:
+            batcher.close()
+
+    def test_depth_under_concurrent_produce_consume(self):
+        registry = MetricsRegistry()
+        batcher = DynamicBatcher(
+            lambda tree, n: tree, minimum_batch_size=1,
+            maximum_batch_size=8, timeout_ms=1.0,
+            metrics_name="qtest2", registry=registry)
+        n_threads, per_thread = 8, 25
+        try:
+            def producer():
+                for _ in range(per_thread):
+                    batcher.compute(np.zeros(2, np.float32))
+
+            threads = [threading.Thread(target=producer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            depths = []
+            while any(t.is_alive() for t in threads):
+                depths.append(registry.snapshot()["qtest2/queue_depth"])
+                time.sleep(0.001)
+            for t in threads:
+                t.join()
+        finally:
+            batcher.close()
+        snapshot = registry.snapshot()
+        # Everything submitted was batched and answered; the gauge reads
+        # empty at quiescence and never went negative mid-flight.
+        assert snapshot["qtest2/queue_depth"] == 0.0
+        assert snapshot["qtest2/batch_size/sum"] == n_threads * per_thread
+        assert snapshot["qtest2/request_latency_s/count"] == (
+            n_threads * per_thread)
+        assert all(d >= 0 for d in depths)
+        assert snapshot["qtest2/occupancy/p99"] <= 1.0
+
+
+class TestPrometheusRendering:
+    def test_golden_exposition_text(self):
+        registry = MetricsRegistry()
+        counter = registry.counter("frames_total", "frames seen")
+        counter.inc(1234)
+        registry.gauge("queue/depth", "queued items").set(3)
+        hist = registry.histogram("stage/latency_s", "stage seconds")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            hist.observe(v)
+        golden = (
+            "# HELP impala_frames_total frames seen\n"
+            "# TYPE impala_frames_total counter\n"
+            "impala_frames_total 1234.0\n"
+            "# HELP impala_queue_depth queued items\n"
+            "# TYPE impala_queue_depth gauge\n"
+            "impala_queue_depth 3.0\n"
+            "# HELP impala_stage_latency_s stage seconds\n"
+            "# TYPE impala_stage_latency_s summary\n"
+            'impala_stage_latency_s{quantile="0.5"} 2.5\n'
+            'impala_stage_latency_s{quantile="0.95"} 3.8499999999999996\n'
+            'impala_stage_latency_s{quantile="0.99"} 3.9699999999999998\n'
+            "impala_stage_latency_s_sum 10.0\n"
+            "impala_stage_latency_s_count 4\n"
+        )
+        assert render_prometheus(registry) == golden
+
+    def test_nan_and_digit_names_render_validly(self):
+        registry = MetricsRegistry()
+        registry.gauge("3d/weird-name")  # leading digit + dash
+        text = render_prometheus(registry)
+        assert "impala__3d_weird_name 0.0" in text
+
+    def test_exporter_dumps_atomically(self, tmp_path):
+        registry = MetricsRegistry()
+        registry.counter("c").inc()
+        exporter = obs.PrometheusExporter(
+            registry, str(tmp_path / "metrics.prom"))
+        text = exporter.dump()
+        assert open(exporter.path).read() == text
+        assert not os.path.exists(exporter.path + ".tmp")
+
+
+class TestStallAttributor:
+    def _observe_actor(self, registry, env_s, infer_s):
+        registry.histogram("actor/env_step_s").observe(env_s)
+        registry.histogram("actor/inference_s").observe(infer_s)
+
+    def test_device_bound_when_learner_saturated(self):
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        category, evidence = attributor.attribute(
+            wait_batch_s=0.01, update_s=1.0)
+        assert category == "device_bound"
+        assert registry.snapshot()["stall/is_device_bound"] == 1.0
+        assert evidence["wait_frac"] < 0.15
+
+    def test_env_bound_when_starved_and_env_dominates(self):
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        self._observe_actor(registry, env_s=2.0, infer_s=0.2)
+        category, _ = attributor.attribute(
+            wait_batch_s=0.8, update_s=0.2)
+        assert category == "env_bound"
+        assert registry.snapshot()[
+            "stall/intervals_env_bound_total"] == 1.0
+
+    def test_learner_starved_when_inference_dominates(self):
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        self._observe_actor(registry, env_s=0.1, infer_s=3.0)
+        category, _ = attributor.attribute(
+            wait_batch_s=0.8, update_s=0.2)
+        assert category == "learner_starved"
+
+    def test_interval_deltas_not_cumulative_sums(self):
+        """The attributor differences the actor histogram sums, so an
+        env-heavy PAST doesn't taint a now-inference-bound interval."""
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        self._observe_actor(registry, env_s=100.0, infer_s=0.1)
+        category, _ = attributor.attribute(0.9, 0.1)
+        assert category == "env_bound"
+        # New interval: only inference time accrues.
+        self._observe_actor(registry, env_s=0.0, infer_s=5.0)
+        category, _ = attributor.attribute(0.9, 0.1)
+        assert category == "learner_starved"
+
+    def test_prior_run_sums_do_not_taint_first_interval(self):
+        """Construction baselines against the registry's CURRENT sums:
+        a second train() on the process-global registry must not charge
+        its first interval with the whole previous run's actor time."""
+        registry = MetricsRegistry()
+        self._observe_actor(registry, env_s=1000.0, infer_s=0.1)  # "run 1"
+        attributor = StallAttributor(registry)
+        category, evidence = attributor.attribute(0.9, 0.1)
+        assert evidence["actor_env_s"] == 0.0
+        assert category == "learner_starved"  # not env_bound from run 1
+
+    def test_describe_is_log_ready(self):
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        category, evidence = attributor.attribute(0.0, 1.0)
+        line = StallAttributor.describe(category, evidence)
+        assert "device_bound" in line and "%" in line
+
+
+class TestTimingSummary:
+    def test_summary_unwraps_avg_and_plain_entries(self):
+        timing = Timing()
+        with timing.time_avg("a"):
+            time.sleep(0.002)
+        with timing.time_avg("a"):
+            time.sleep(0.002)
+        with timing.add_time("b"):
+            time.sleep(0.001)
+        with timing.timeit("c"):
+            pass
+        summary = timing.summary()
+        assert set(summary) == {"a", "b", "c"}
+        assert all(isinstance(v, float) for v in summary.values())
+        assert summary["a"] == pytest.approx(timing["a"].value)
+        assert summary["b"] >= 0.001
+
+
+class TestMetricsWriter:
+    def test_explicit_zero_wall_time_preserved(self, tmp_path):
+        with MetricsWriter(str(tmp_path)) as writer:
+            writer.write(0, {"x": 1.0}, wall_time=0.0)
+            writer.write(1, {"x": 2.0})
+        rows = [json.loads(line) for line in
+                open(tmp_path / "metrics.jsonl")]
+        assert rows[0]["time"] == 0.0  # `or time.time()` would clobber it
+        assert rows[1]["time"] > 0.0
+
+    def test_context_manager_closes_on_exception(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            with MetricsWriter(str(tmp_path)) as writer:
+                writer.write(0, {"x": 1.0})
+                raise RuntimeError("loop died")
+        assert writer._jsonl.closed
+        rows = [json.loads(line) for line in
+                open(tmp_path / "metrics.jsonl")]
+        assert rows and rows[0]["x"] == 1.0  # flushed despite the raise
+
+    def test_write_registry_namespaces_snapshot(self, tmp_path):
+        registry = MetricsRegistry()
+        registry.counter("frames_total").inc(5)
+        with MetricsWriter(str(tmp_path), registry=registry) as writer:
+            writer.write_registry(3)
+        rows = [json.loads(line) for line in
+                open(tmp_path / "metrics.jsonl")]
+        assert rows[0]["obs/frames_total"] == 5.0
+        assert rows[0]["step"] == 3

@@ -1,0 +1,96 @@
+"""Tier-1 observability smoke: a few REAL driver updates with tracing on
+must yield (a) a Perfetto-loadable Chrome trace whose spans cover the
+actor, batcher/queue, and learner stages, and (b) a Prometheus snapshot
+carrying queue-depth gauges, stage-latency histograms, and the stall
+verdict (ISSUE 1 acceptance criteria).  Deliberately NOT marked slow —
+this is the fast CI guard that the obs wiring stays alive — so the
+config is the smallest that still crosses every pipeline stage."""
+
+import json
+import os
+
+import numpy as np
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.driver import train as run_train
+from scalable_agent_tpu.obs import load_trace_events
+
+
+def test_traced_driver_run_emits_trace_and_prometheus(tmp_path):
+    config = Config(
+        mode="train",
+        logdir=str(tmp_path / "run"),
+        level_name="fake_small",
+        num_actors=4,
+        batch_size=2,
+        unroll_length=4,
+        num_action_repeats=1,
+        total_environment_frames=16,  # 2 updates of 8 frames
+        height=16,
+        width=16,
+        num_env_workers_per_group=2,
+        compute_dtype="float32",
+        checkpoint_interval_s=1e9,
+        log_interval_s=0.0,  # log (and dump prometheus) every update
+        trace=True,
+        seed=5,
+    )
+    metrics = run_train(config)
+    assert metrics["env_frames"] == 16
+    assert np.isfinite(metrics["total_loss"])
+
+    # -- (a) the Chrome trace ---------------------------------------------
+    trace_path = os.path.join(config.logdir, "trace.json")
+    assert os.path.exists(trace_path)
+    events = list(load_trace_events(trace_path))
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans, "no complete spans recorded"
+    # Well-formed trace events on real (pid, tid) tracks.
+    for e in spans:
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+    names = {e["name"] for e in spans}
+    # Every pipeline stage contributed spans.
+    assert any(n.startswith("actor/") for n in names), names
+    assert any(n.startswith("batcher/") for n in names), names
+    assert any(n.startswith("learner/") for n in names), names
+    # Perfetto-loadable: terminating the open array yields strict JSON.
+    raw = open(trace_path).read()
+    assert json.loads(raw.rstrip().rstrip(",") + "]")
+    # Nesting: per-step actor spans sit inside their unroll span.
+    unrolls = [e for e in spans if e["name"] == "actor/unroll"]
+    steps = [e for e in spans if e["name"] == "actor/inference"]
+    assert unrolls and steps
+    nested = any(
+        u["tid"] == s["tid"]
+        and u["ts"] <= s["ts"]
+        and s["ts"] + s["dur"] <= u["ts"] + u["dur"]
+        for u in unrolls for s in steps)
+    assert nested, "no actor/inference span nested in an actor/unroll"
+
+    # -- (b) the Prometheus snapshot --------------------------------------
+    prom_path = os.path.join(config.logdir, "metrics.prom")
+    assert os.path.exists(prom_path)
+    text = open(prom_path).read()
+    # Queue-depth gauges.
+    assert "impala_actor_pool_queue_depth" in text
+    # Stage-latency histograms with quantiles.
+    assert 'impala_actor_inference_s{quantile="0.5"}' in text
+    assert 'impala_learner_put_trajectory_s{quantile="0.5"}' in text
+    assert 'quantile="0.99"' in text
+    # Stall-attribution metrics, and exactly one category asserted.
+    assert "impala_stall_frac_wait_batch" in text
+    flags = {
+        line.split()[0]: float(line.split()[1])
+        for line in text.splitlines()
+        if line.startswith("impala_stall_is_")}
+    assert len(flags) == 3 and sum(flags.values()) == 1.0
+    # Separate actor-vs-learner FPS/frame accounting made it through.
+    assert "impala_actor_agent_steps_total" in text
+    assert "impala_learner_env_frames_total" in text
+
+    # The metrics JSONL got both training rows and registry rows.
+    rows = [json.loads(line) for line in
+            open(os.path.join(config.logdir, "metrics.jsonl"))]
+    assert any("total_loss" in r for r in rows)
+    assert any("timing/update" in r for r in rows)
+    assert any(any(k.startswith("obs/") for k in r) for r in rows)
