@@ -136,7 +136,27 @@ class Config:
     # 2M-event budget (~200 MB) so long runs can't fill the disk.  The
     # metrics registry + Prometheus snapshot (<logdir>/metrics.prom) and
     # the stall attributor are always on; see docs/observability.md.
+    # Trace files carry a .p<proc>.<pid> suffix so two runs sharing a
+    # logdir (or N processes of one run) can never clobber each other;
+    # `python -m scalable_agent_tpu.obs.aggregate <logdir>` merges them.
     trace: bool = False
+    # Watchdog (obs/watchdog.py): a pipeline thread (actor, batcher
+    # consumer, prefetch, learner) that makes no progress for this many
+    # seconds trips the stalled_thread verdict and dumps the flight
+    # recorder + all-thread stacks (<logdir>/flightrec.<pid>.json,
+    # stacks.<pid>.txt).  0 disables (unit tests construct their own).
+    # The default is generous: it must sit above a worst-case production
+    # compile or checkpoint, not above a step.
+    watchdog_timeout_s: float = 300.0
+    # Abort the process (exit 70) after the watchdog dump instead of
+    # hanging forever — the right setting under a supervisor that
+    # restarts failed workers.
+    watchdog_abort: bool = False
+    # Serve live Prometheus text over HTTP at this port (0 = disabled):
+    # scrapers hit http://host:<port>/metrics instead of polling
+    # <logdir>/metrics.prom off disk.  Multi-process runs offset the
+    # port by the process index.
+    metrics_http_port: int = 0
 
     # -------------------------------------------------------------------
 

@@ -36,12 +36,18 @@ from scalable_agent_tpu.envs import dmlab30
 from scalable_agent_tpu.envs.spec import TensorSpec
 from scalable_agent_tpu.models import ImpalaAgent, actor_step, initial_state
 from scalable_agent_tpu.obs import (
+    MetricsHTTPServer,
     MetricsWriter,
     PrometheusExporter,
     StallAttributor,
+    configure_flight_recorder,
     configure_tracer,
+    configure_watchdog,
+    get_flight_recorder,
     get_registry,
     get_tracer,
+    get_watchdog,
+    install_crash_handlers,
 )
 from scalable_agent_tpu.parallel import MeshSpec, make_mesh
 from scalable_agent_tpu.runtime import (
@@ -342,23 +348,36 @@ def start_prefetch(pool, learner, staged: queue_lib.Queue,
     Exceptions surface through the staged queue."""
 
     def prefetch_loop():
+        watchdog = get_watchdog()
         try:
             while not stop.is_set():
+                # Every bounded wait below re-touches, so the prefetch
+                # heartbeat only goes stale when the thread truly wedges
+                # (e.g. inside a hung device placement).
+                watchdog.touch()
                 try:
                     out = pool.get_trajectory(timeout=0.5)
                 except queue_lib.Empty:
                     continue
                 traj = learner.put_trajectory(to_trajectory(out))
                 while not stop.is_set():
+                    watchdog.touch()
                     try:
                         staged.put(traj, timeout=0.5)
                         break
                     except queue_lib.Full:
                         continue
         except Exception as exc:  # surface in the consumer loop
+            recorder = get_flight_recorder()
+            recorder.record("exception", type(exc).__name__,
+                            {"where": "prefetch"})
+            recorder.dump_all(f"exception:{type(exc).__name__}:prefetch")
             staged.put(exc)
+        finally:
+            watchdog.suspend()
 
-    thread = threading.Thread(target=prefetch_loop, daemon=True)
+    thread = threading.Thread(target=prefetch_loop, daemon=True,
+                              name="prefetch")
     thread.start()
     return thread
 
@@ -371,31 +390,88 @@ def _host_scalar(x) -> float:
     return float(np.asarray(x))
 
 
-def _setup_observability(config: Config, coordinator: bool):
+@dataclasses.dataclass
+class _ObsHandles:
+    """Everything _setup_observability wires and _teardown unwinds."""
+
+    registry: object
+    prom: Optional[PrometheusExporter]
+    http: Optional[MetricsHTTPServer] = None
+    uninstall_handlers: Optional[callable] = None
+
+
+def _setup_observability(config: Config, coordinator: bool) -> _ObsHandles:
     """Wire the obs subsystem for one training run: the span tracer
-    (--trace -> <logdir>/trace.json), JAX recompile/memory hooks on the
-    global registry, and the coordinator's Prometheus snapshot file.
-    Returns (registry, prometheus_exporter_or_None)."""
+    (--trace -> <logdir>/trace.p<proc>.<pid>.json), JAX recompile/memory
+    hooks on the global registry, a per-process Prometheus snapshot file
+    (the coordinator keeps the plain metrics.prom name), the flight
+    recorder + crash handlers (SIGTERM/SIGINT, unhandled exceptions),
+    the watchdog (--watchdog_timeout_s), and the optional live scrape
+    endpoint (--metrics_http_port)."""
+    proc = jax.process_index()
     if config.trace:
-        # Multi-process runs share logdir; each non-primary process gets
-        # its own file so concurrent writers can't clobber each other
-        # (the Chrome `pid` field keeps them distinguishable if merged).
-        proc = jax.process_index()
-        name = "trace.json" if proc == 0 else f"trace.p{proc}.json"
-        configure_tracer(os.path.join(config.logdir, name))
+        # Per-(process, pid) file names: N processes of one run share
+        # the logdir, and two runs pointed at the same logdir must not
+        # clobber each other's trace.  obs/aggregate.py merges them.
+        name = f"trace.p{proc}.{os.getpid()}.json"
+        configure_tracer(os.path.join(config.logdir, name),
+                         process_index=proc)
     registry = get_registry().install_jax_hooks()
-    prom = (PrometheusExporter(
-        registry, os.path.join(config.logdir, "metrics.prom"))
-        if coordinator else None)
-    return registry, prom
+    prom_name = "metrics.prom" if coordinator else f"metrics.p{proc}.prom"
+    prom = PrometheusExporter(
+        registry, os.path.join(config.logdir, prom_name))
+    # Failure forensics: the ring buffer dumps (with all-thread stacks
+    # and a final prom snapshot) on SIGTERM/SIGINT, unhandled
+    # exceptions, and watchdog stalls.
+    recorder = configure_flight_recorder(config.logdir,
+                                         process_index=proc,
+                                         registry=registry)
+    recorder.exporter = prom
+    uninstall = install_crash_handlers(recorder)
+    configure_watchdog(config.watchdog_timeout_s, registry=registry,
+                       abort=config.watchdog_abort,
+                       flight_recorder=recorder)
+    http = None
+    if config.metrics_http_port:
+        try:
+            http = MetricsHTTPServer(registry,
+                                     config.metrics_http_port + proc)
+            log.info("serving Prometheus metrics on :%d/metrics",
+                     http.port)
+        except OSError as exc:  # a taken port must not kill training
+            log.error("metrics HTTP endpoint unavailable on port %d: %s",
+                      config.metrics_http_port + proc, exc)
+    return _ObsHandles(registry=registry, prom=prom, http=http,
+                       uninstall_handlers=uninstall)
 
 
-def _teardown_observability(config: Config, prom):
-    """Flush the trace tail and the final metrics snapshot."""
+def _teardown_observability(config: Config, handles: _ObsHandles):
+    """Dump forensics if we are unwinding an exception, then flush the
+    trace tail and the final metrics snapshot and unwind the hooks."""
+    import sys
+
+    recorder = get_flight_recorder()
+    exc = sys.exc_info()[1]
+    if exc is not None and not isinstance(exc, (SystemExit,
+                                                KeyboardInterrupt)):
+        # Exceptions unwinding through train() dump here, while every
+        # thread whose stack explains the failure is still alive.
+        recorder.dump_all(f"exception:{type(exc).__name__}")
+    elif recorder.pending_dump_reason:
+        # A signal handler requested the dump: its in-handler attempt
+        # may have been abandoned (bounded join) if the interrupted
+        # frame held a tracer/instrument lock — this stack is clean,
+        # so complete/refresh it now.
+        recorder.dump_all(recorder.pending_dump_reason)
+    configure_watchdog(None)
+    if handles.http is not None:
+        handles.http.close()
     if config.trace:
         configure_tracer(None)  # closes (and flushes) the file tracer
-    if prom is not None:
-        prom.dump()
+    if handles.prom is not None:
+        handles.prom.dump()
+    if handles.uninstall_handlers is not None:
+        handles.uninstall_handlers()
 
 
 def train(config: Config) -> Dict[str, float]:
@@ -430,10 +506,12 @@ def train(config: Config) -> Dict[str, float]:
     if is_coordinator():
         config.save()
     # Observability comes up BEFORE the actor pool so its threads are
-    # born with the live tracer (spans from the very first unroll); the
-    # try below owns teardown from this point on, so a failure anywhere
-    # in construction still flushes/closes the trace file.
-    registry, prom = _setup_observability(config, is_coordinator())
+    # born with the live tracer and watchdog (spans/heartbeats from the
+    # very first unroll); the try below owns teardown from this point
+    # on, so a failure anywhere in construction still flushes/closes
+    # the trace file and dumps the flight recorder.
+    obs_handles = _setup_observability(config, is_coordinator())
+    registry, prom = obs_handles.registry, obs_handles.prom
     pool = prefetch_thread = writer = ckpt = None
     prefetch_stop = threading.Event()
     profiling = False
@@ -518,6 +596,7 @@ def train(config: Config) -> Dict[str, float]:
         # +profile_num_updates) viewable in TensorBoard/XProf — the tool
         # for locating host↔device stalls the Timing counters can't
         # attribute.
+        watchdog = get_watchdog()
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
                     and updates - start_updates
@@ -529,14 +608,21 @@ def train(config: Config) -> Dict[str, float]:
                 get_tracer().set_annotate(True)
                 profiling = True
                 profile_stop_at = updates + config.profile_num_updates
+            # Disarm the learner heartbeat while blocked on the staged
+            # queue: starvation is the stall attributor's domain, and a
+            # wedged UPSTREAM thread's own stale heartbeat names the
+            # culprit — the learner waiting on it is a symptom.
+            watchdog.suspend("learner")
             with timing.time_avg("wait_batch"), \
                     interval.add_time("wait_batch"), \
                     get_tracer().span("learner/wait_batch", cat="learner"):
                 traj = staged.get()
+            watchdog.touch("learner")
             if isinstance(traj, Exception):
                 raise traj
             with timing.time_avg("update"), interval.add_time("update"):
                 state, metrics = learner.update(state, traj)
+            watchdog.touch("learner")
             pool.set_params(state.params, version=updates)
             updates += 1
             frames += frames_per_update
@@ -630,9 +716,20 @@ def train(config: Config) -> Dict[str, float]:
                     StallAttributor.describe(category, evidence))
                 last_log, frames_at_last_log = now, frames
             ckpt.maybe_save(updates, state)
+        # Disarm before the shutdown tail (final forced checkpoint,
+        # pool joins, writer close): a slow-but-healthy shutdown must
+        # not read as a stalled_thread wedge — and must never be
+        # os._exit'ed mid-checkpoint under --watchdog_abort.
+        watchdog.suspend("learner")
         ckpt.maybe_save(updates, state, force=True)
         completed = True
     finally:
+        # Disarm the watchdog for the WHOLE teardown tail — the
+        # exception path skips the loop-exit suspend above, and pool
+        # joins/writer/ckpt closes must never be os._exit(70)'d by a
+        # heartbeat that simply stopped because the run is ending.
+        # (The exception dump in _teardown_observability still runs.)
+        configure_watchdog(None)
         if profiling:
             jax.profiler.stop_trace()
         prefetch_stop.set()
@@ -646,7 +743,7 @@ def train(config: Config) -> Dict[str, float]:
             writer.close()
         if ckpt is not None:
             ckpt.close()
-        _teardown_observability(config, prom)
+        _teardown_observability(config, obs_handles)
         if completed and jax.process_count() > 1:
             # No process may exit (tearing down the coordination
             # service) until every process finished its checkpoint IO.
@@ -772,7 +869,9 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     metrics = {}
     # Setup immediately before the try that owns teardown: nothing can
     # raise in between, so the trace file can't leak.
-    registry, prom = _setup_observability(config, coordinator=True)
+    obs_handles = _setup_observability(config, coordinator=True)
+    registry, prom = obs_handles.registry, obs_handles.prom
+    watchdog = get_watchdog()
     try:
         # Context-managed writer: the JSONL handle can't leak when the
         # loop (or checkpointing) raises.
@@ -787,6 +886,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # have used.
                     state, carry, metrics = trainer.train_step(
                         state, carry, np.int32(updates))
+                watchdog.touch("learner")
                 updates += 1
                 frames += frames_per_update
                 now = time.monotonic()
@@ -811,10 +911,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                                  for k, v in timing_summary.items()))
                     last_log, frames_at_last_log = now, frames
                 ckpt.maybe_save(updates, state)
+            # Same shutdown-tail disarm as the host backend: the final
+            # forced save must not trip (or be aborted by) the watchdog.
+            watchdog.suspend("learner")
             ckpt.maybe_save(updates, state, force=True)
     finally:
+        configure_watchdog(None)  # same teardown-tail disarm as train()
         ckpt.close()
-        _teardown_observability(config, prom)
+        _teardown_observability(config, obs_handles)
     return _finalize_ingraph_metrics(metrics, config)
 
 

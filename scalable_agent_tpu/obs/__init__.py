@@ -1,20 +1,34 @@
-"""Observability subsystem: tracing, metrics registry, stall attribution.
+"""Observability subsystem: tracing, metrics, stall + failure forensics.
 
-The runtime instruments itself against two process-global singletons —
-``get_tracer()`` (obs/trace.py, Chrome-trace spans, disabled by default
-and near-free when disabled) and ``get_registry()`` (obs/registry.py,
-counters/gauges/histograms, always live).  Exporters (obs/exporters.py)
-turn the registry into Prometheus text exposition and feed the JSONL/
-TensorBoard metrics sink; the stall attributor (obs/stall.py) turns the
-per-interval timings into a named pipeline-bottleneck verdict.
+The runtime instruments itself against a handful of process-global
+singletons — ``get_tracer()`` (obs/trace.py, Chrome-trace spans,
+disabled by default and near-free when disabled), ``get_registry()``
+(obs/registry.py, counters/gauges/histograms, always live),
+``get_flight_recorder()`` (obs/flightrec.py, always-on ring buffer of
+the last ~64k runtime events, dumped with all-thread stacks on
+signal/exception/watchdog), and ``get_watchdog()`` (obs/watchdog.py,
+heartbeat registry + stale-thread monitor, disabled by default).
+Exporters (obs/exporters.py) turn the registry into Prometheus text —
+snapshot file or live HTTP endpoint — and feed the JSONL/TensorBoard
+metrics sink; the stall attributor (obs/stall.py) turns per-interval
+timings into a named pipeline-bottleneck verdict (including the
+watchdog's ``stalled_thread``); obs/aggregate.py merges a multi-process
+run's traces and metric snapshots into one fleet view.
 
 See docs/observability.md for the metric-name schema and workflows.
 """
 
 from scalable_agent_tpu.obs.exporters import (
+    MetricsHTTPServer,
     MetricsWriter,
     PrometheusExporter,
     render_prometheus,
+)
+from scalable_agent_tpu.obs.flightrec import (
+    FlightRecorder,
+    configure_flight_recorder,
+    get_flight_recorder,
+    install_crash_handlers,
 )
 from scalable_agent_tpu.obs.registry import (
     Counter,
@@ -31,20 +45,33 @@ from scalable_agent_tpu.obs.trace import (
     load_trace_events,
     span,
 )
+from scalable_agent_tpu.obs.watchdog import (
+    Watchdog,
+    configure_watchdog,
+    get_watchdog,
+)
 
 __all__ = [
     "CATEGORIES",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MetricsHTTPServer",
     "MetricsRegistry",
     "MetricsWriter",
     "PrometheusExporter",
     "StallAttributor",
     "Tracer",
+    "Watchdog",
+    "configure_flight_recorder",
     "configure_tracer",
+    "configure_watchdog",
+    "get_flight_recorder",
     "get_registry",
     "get_tracer",
+    "get_watchdog",
+    "install_crash_handlers",
     "load_trace_events",
     "render_prometheus",
     "span",

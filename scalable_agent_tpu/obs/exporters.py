@@ -17,6 +17,7 @@ Two consumers, one registry (obs/registry.py):
 import json
 import os
 import re
+import threading
 import time
 from typing import Dict, Optional
 
@@ -27,7 +28,8 @@ from scalable_agent_tpu.obs.registry import (
     MetricsRegistry,
 )
 
-__all__ = ["MetricsWriter", "PrometheusExporter", "render_prometheus"]
+__all__ = ["MetricsHTTPServer", "MetricsWriter", "PrometheusExporter",
+           "render_prometheus"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _PREFIX = "impala_"
@@ -90,6 +92,66 @@ class PrometheusExporter:
             f.write(text)
         os.replace(tmp, self.path)
         return text
+
+
+class MetricsHTTPServer:
+    """A stdlib Prometheus scrape endpoint (``--metrics_http_port``).
+
+    Serves the registry's CURRENT exposition text on ``/metrics`` (and
+    ``/``) so scrapers don't have to poll ``<logdir>/metrics.prom`` off
+    disk.  ``http.server.ThreadingHTTPServer`` on a daemon thread —
+    rendering happens per request, never on the training hot path.
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` for the
+    bound value.
+    """
+
+    def __init__(self, registry: MetricsRegistry, port: int,
+                 host: str = "0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_prometheus(outer._registry).encode()
+                except Exception as exc:  # a dying gauge must 500, not die
+                    self.send_error(500, str(exc))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # no per-scrape stdout spam
+                pass
+
+        self._registry = registry
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="metrics-http")
+        self._thread.start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+        return False
 
 
 class MetricsWriter:

@@ -1,0 +1,350 @@
+"""Flight recorder: make failure the best-instrumented moment of a run.
+
+The tracer (obs/trace.py) and registry (obs/registry.py) see healthy
+runs; when a process wedges or crashes, the tracer's unflushed tail, the
+registry's last state, and the stall verdict all evaporate.  The
+``FlightRecorder`` is the failure-path complement: an always-on ring
+buffer of the last ~64k structured runtime events (unroll boundaries,
+queue hand-offs, update step numbers, heartbeat scans, completed spans
+while tracing) that costs one ``deque.append`` per event — CPython's
+``deque(maxlen=...)`` appends are atomic, so the hot path takes NO lock
+— and dumps everything that matters on the way down:
+
+- ``<logdir>/flightrec.<pid>.json``: the ring's tail, the registry
+  snapshot, and clock epochs (written atomically, tmp + rename).
+- ``<logdir>/stacks.<pid>.txt``: a ``faulthandler`` dump of EVERY
+  thread's Python stack — the single most useful artifact for a hang.
+- a final ``metrics.prom`` snapshot through the attached exporter.
+
+``install_crash_handlers`` wires the dump to SIGTERM/SIGINT (then raises
+``SystemExit``/``KeyboardInterrupt`` so the driver's ``finally`` still
+flushes the trace), to ``sys.excepthook``, and to ``threading.excepthook``
+(actor/batcher/prefetch threads).  The watchdog (obs/watchdog.py) calls
+the same dump when a heartbeat goes stale.  See docs/observability.md
+("debugging a hung run").
+"""
+
+import faulthandler
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "configure_flight_recorder",
+    "get_flight_recorder",
+    "install_crash_handlers",
+]
+
+_SCHEMA_VERSION = 1
+
+
+def _perf_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class FlightRecorder:
+    """Lock-free-append ring buffer of structured runtime events.
+
+    Events are ``(ts_us, kind, name, thread, args)`` tuples with
+    ``perf_counter``-based microsecond timestamps — the same clock the
+    tracer uses, so a flight-recorder dump and a trace from the same
+    process align directly (both also record the unix-time epoch pair
+    for cross-process alignment, see obs/aggregate.py).
+    """
+
+    def __init__(self, capacity: int = 65536,
+                 logdir: Optional[str] = None,
+                 process_index: int = 0,
+                 registry=None):
+        self.capacity = capacity
+        self.logdir = logdir
+        self.process_index = process_index
+        self.exporter = None  # optional PrometheusExporter, set by driver
+        self._registry = registry
+        # deque(maxlen): appends are atomic in CPython, so record() takes
+        # no lock — the one property that keeps an always-on recorder off
+        # the hot path's profile.
+        self._events = deque(maxlen=capacity)
+        self._thread_names: Dict[int, str] = {}
+        # Back-to-back epoch pair: lets tooling convert perf-us event
+        # timestamps to wall time (and align multiple processes).
+        self._epoch_unix_us = int(time.time() * 1e6)
+        self._epoch_perf_us = _perf_us()
+        self._dump_lock = threading.Lock()
+        self._dump_all_lock = threading.Lock()
+        self.dump_count = 0
+        self.last_dump_reason: Optional[str] = None
+        # Set by the signal handler so the driver's teardown (running
+        # on a clean stack) can complete/refresh the forensic dump even
+        # when the in-handler attempt had to be abandoned (see
+        # install_crash_handlers).
+        self.pending_dump_reason: Optional[str] = None
+
+    # -- recording (hot path) ----------------------------------------------
+
+    def _thread_name(self) -> str:
+        ident = threading.get_ident()
+        tname = self._thread_names.get(ident)
+        if tname is None:
+            tname = threading.current_thread().name
+            self._thread_names[ident] = tname
+        return tname
+
+    def record(self, kind: str, name: str, args: Optional[dict] = None):
+        """Append one event.  ~sub-microsecond: a dict hit for the thread
+        name plus one atomic deque append (bench.py bench_obs measures
+        this every round as ``obs_flightrec_record_us``)."""
+        self._events.append(
+            (_perf_us(), kind, name, self._thread_name(), args))
+
+    def record_span(self, name: str, cat: str, ts_us: int, dur_us: int):
+        """Completed-span feed from the tracer (only while tracing): the
+        ring then holds the spans the unflushed trace tail would lose."""
+        self._events.append(
+            (ts_us, "span", name, self._thread_name(),
+             {"cat": cat, "dur_us": dur_us}))
+
+    # -- inspection --------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        """The ring's current contents, oldest first, as dicts."""
+        return [
+            {"ts_us": ts, "kind": kind, "name": name, "thread": thread,
+             **({"args": args} if args else {})}
+            for ts, kind, name, thread, args in list(self._events)
+        ]
+
+    # -- dumping (failure path) --------------------------------------------
+
+    def dump_path(self) -> Optional[str]:
+        if self.logdir is None:
+            return None
+        return os.path.join(self.logdir, f"flightrec.{os.getpid()}.json")
+
+    def stacks_path(self) -> Optional[str]:
+        if self.logdir is None:
+            return None
+        return os.path.join(self.logdir, f"stacks.{os.getpid()}.txt")
+
+    def dump(self, reason: str, path: Optional[str] = None
+             ) -> Optional[str]:
+        """Write the flight-recorder JSON atomically.  Returns the path,
+        or None when no logdir is configured (tests, library use).  Safe
+        to call repeatedly — the newest dump (with the most events) wins."""
+        path = path or self.dump_path()
+        if path is None:
+            return None
+        # Non-blocking: a signal can land MID-DUMP on the very thread
+        # holding this lock (SIGTERM while sys.excepthook dumps), and a
+        # blocking acquire would self-deadlock the shutdown path.  The
+        # in-progress dump is current enough — skip the nested one.
+        if not self._dump_lock.acquire(blocking=False):
+            return None
+        try:
+            self.dump_count += 1
+            self.last_dump_reason = reason
+            try:
+                metrics = self._registry_snapshot()
+            except Exception:
+                metrics = {}
+            payload = {
+                "schema_version": _SCHEMA_VERSION,
+                "reason": reason,
+                "pid": os.getpid(),
+                "process_index": self.process_index,
+                "dump_count": self.dump_count,
+                "epoch_unix_us": self._epoch_unix_us,
+                "epoch_perf_us": self._epoch_perf_us,
+                "dumped_at_unix_us": int(time.time() * 1e6),
+                "capacity": self.capacity,
+                "metrics": metrics,
+                "events": self.snapshot(),
+            }
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        finally:
+            self._dump_lock.release()
+        return path
+
+    def dump_stacks(self, path: Optional[str] = None) -> Optional[str]:
+        """``faulthandler`` dump of every thread's Python stack — what a
+        hung run's operator reads first."""
+        path = path or self.stacks_path()
+        if path is None:
+            return None
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(f"# all-thread stack dump pid={os.getpid()} "
+                    f"reason={self.last_dump_reason}\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+        return path
+
+    def dump_all(self, reason: str) -> Optional[str]:
+        """The full forensic drop: ring JSON + all-thread stacks + a
+        final Prometheus snapshot (when an exporter is attached).  Never
+        raises — this runs on paths where a second failure must not mask
+        the first.  One writer at a time (non-blocking): two failure
+        triggers firing together (watchdog + SIGTERM, two dying
+        threads) would otherwise interleave writes into the same
+        stacks/prom files and tear exactly the artifacts the operator
+        reads first — the concurrent caller skips, the dump already in
+        flight is current enough."""
+        if not self._dump_all_lock.acquire(blocking=False):
+            return None
+        try:
+            try:
+                path = self.dump(reason)
+            except Exception:
+                path = None
+            try:
+                self.dump_stacks()
+            except Exception:
+                pass
+            if self.exporter is not None:
+                try:
+                    self.exporter.dump()
+                except Exception:
+                    pass
+            try:
+                # Flush the tracer's buffered tail (up to flush_every
+                # lines): on the watchdog's --watchdog_abort os._exit
+                # path nothing else ever will, and the most recent
+                # spans are exactly the window a hang post-mortem
+                # needs.  (Late import: trace.py imports this module.)
+                from scalable_agent_tpu.obs.trace import get_tracer
+
+                get_tracer().flush()
+            except Exception:
+                pass
+        finally:
+            self._dump_all_lock.release()
+        return path
+
+    def _registry_snapshot(self) -> Dict[str, float]:
+        registry = self._registry
+        if registry is None:
+            from scalable_agent_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        return registry.snapshot()
+
+
+# -- module-global recorder --------------------------------------------------
+# Always live (a recorder without a logdir still records; dump() is a
+# no-op until the driver configures a destination), so instrumented
+# runtime code never branches on "is there a recorder".
+
+_recorder = FlightRecorder()
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def configure_flight_recorder(logdir: Optional[str],
+                              process_index: int = 0,
+                              capacity: int = 65536,
+                              registry=None) -> FlightRecorder:
+    """Install (and return) the process-global flight recorder with a
+    dump destination.  ``logdir=None`` restores an unconfigured recorder
+    (events still ring-buffer; dumps go nowhere)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = FlightRecorder(
+            capacity=capacity, logdir=logdir,
+            process_index=process_index, registry=registry)
+        return _recorder
+
+
+# -- crash handlers ----------------------------------------------------------
+
+
+def install_crash_handlers(recorder: Optional[FlightRecorder] = None,
+                           handled_signals=(signal.SIGTERM, signal.SIGINT),
+                           ) -> Callable[[], None]:
+    """Dump the flight recorder on the ways a run dies.
+
+    - SIGTERM/SIGINT: dump, then raise ``SystemExit(128+sig)`` /
+      ``KeyboardInterrupt`` so the driver's ``finally`` still runs
+      (trace flush, pool stop).  The dump itself runs on a HELPER
+      thread with a bounded join: the handler interrupts the main
+      thread at an arbitrary bytecode, possibly while it holds the
+      tracer's or an instrument's non-reentrant lock — dumping inline
+      would self-deadlock on those exact locks.  On a clean stack the
+      helper finishes in well under the join bound; in the
+      held-lock case the join times out, the raise unwinds (releasing
+      the lock, letting the helper finish), and the driver's teardown
+      re-dumps via ``pending_dump_reason``.  Signal handlers require
+      the main thread; elsewhere this layer is skipped silently.
+    - ``sys.excepthook`` / ``threading.excepthook``: dump, then chain to
+      the previous hook (so tracebacks still print).
+
+    Returns an ``uninstall()`` callable restoring every previous hook —
+    the driver calls it in teardown so tests and sequential runs can't
+    accumulate handlers.
+    """
+    rec = recorder or get_flight_recorder()
+    prev_signal = {}
+    try:
+        for sig in handled_signals:
+            def _on_signal(signum, frame):
+                name = signal.Signals(signum).name
+                rec.record("signal", name)  # lock-free ring append
+                rec.pending_dump_reason = f"signal:{name}"
+                dumper = threading.Thread(
+                    target=rec.dump_all, args=(f"signal:{name}",),
+                    daemon=True, name="flightrec-dump")
+                dumper.start()
+                dumper.join(timeout=5.0)
+                if signum == signal.SIGINT:
+                    raise KeyboardInterrupt
+                raise SystemExit(128 + signum)
+
+            prev_signal[sig] = signal.signal(sig, _on_signal)
+    except ValueError:
+        # Not the main thread (train() driven from a worker thread):
+        # signals stay with whoever owns the main thread.
+        prev_signal.clear()
+
+    prev_sys_hook = sys.excepthook
+
+    def _sys_hook(exc_type, exc, tb):
+        rec.record("exception", exc_type.__name__, {"where": "main"})
+        rec.dump_all(f"exception:{exc_type.__name__}")
+        prev_sys_hook(exc_type, exc, tb)
+
+    sys.excepthook = _sys_hook
+
+    prev_thread_hook = threading.excepthook
+
+    def _thread_hook(args):
+        name = getattr(args.exc_type, "__name__", "Exception")
+        thread_name = getattr(args.thread, "name", "?")
+        rec.record("exception", name, {"where": thread_name})
+        rec.dump_all(f"exception:{name}:{thread_name}")
+        prev_thread_hook(args)
+
+    threading.excepthook = _thread_hook
+
+    def uninstall():
+        for sig, prev in prev_signal.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass
+        sys.excepthook = prev_sys_hook
+        threading.excepthook = prev_thread_hook
+
+    return uninstall

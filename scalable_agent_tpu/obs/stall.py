@@ -18,6 +18,12 @@ string:
   dominate the actor side: the gap is inference dispatch, host<->device
   transfer, or queue hand-off.  Fix: inference_mode=accum/accum_fused,
   larger groups, link tuning (runtime/linktune.py).
+- ``stalled_thread``  — not an interval classification at all: a
+  pipeline thread missed its watchdog heartbeat deadline
+  (obs/watchdog.py calls ``report_stalled``).  The run is wedged, not
+  slow.  Fix: read ``<logdir>/stacks.<pid>.txt`` and
+  ``flightrec.<pid>.json`` (docs/observability.md, "debugging a hung
+  run").
 
 Inputs are the driver's per-interval wait/update seconds plus the
 actor-side env/inference histograms the runtime already feeds into the
@@ -31,7 +37,8 @@ from scalable_agent_tpu.obs.registry import MetricsRegistry, get_registry
 
 __all__ = ["StallAttributor", "CATEGORIES"]
 
-CATEGORIES = ("device_bound", "env_bound", "learner_starved")
+CATEGORIES = ("device_bound", "env_bound", "learner_starved",
+              "stalled_thread")
 
 # Actor-side stage histograms the runtime populates (runtime/actor.py,
 # runtime/accum_actor.py).  Sums are cumulative seconds across threads.
@@ -109,6 +116,26 @@ class StallAttributor:
             "actor_env_s": env_s,
             "actor_infer_s": infer_s,
         }
+
+    def report_stalled(self, stalled: Dict[str, float],
+                       count: bool = True) -> str:
+        """Watchdog path (obs/watchdog.py): ``stalled`` maps thread name
+        -> heartbeat age in seconds.  One-hots the ``stalled_thread``
+        verdict through the same gauges the interval attribution uses,
+        counts the interval (``count=False`` re-asserts the gauges only
+        — the watchdog uses it to keep the verdict visible after a
+        later ``attribute()`` call one-hots its own category while the
+        wedge persists), and returns the log-ready line."""
+        for name, gauge in self._category_gauges.items():
+            gauge.set(1.0 if name == "stalled_thread" else 0.0)
+        if count:
+            self._category_counters["stalled_thread"].inc()
+        return ("pipeline stalled_thread ("
+                + ", ".join(f"{name} silent {age:.1f}s"
+                            for name, age in sorted(
+                                stalled.items(),
+                                key=lambda item: -item[1]))
+                + ")")
 
     @staticmethod
     def describe(category: str, fractions: Dict[str, float]) -> str:

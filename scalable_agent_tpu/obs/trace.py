@@ -55,6 +55,12 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+# flightrec imports only stdlib, so this direct submodule import is
+# cycle-free even though both live under the obs package.
+from scalable_agent_tpu.obs.flightrec import (  # noqa: E402
+    get_flight_recorder as _flight_recorder,
+)
+
 
 class _Span:
     __slots__ = ("_tracer", "_name", "_cat", "_args", "_start_us",
@@ -102,9 +108,11 @@ class Tracer:
                  process_name: str = "scalable_agent_tpu",
                  annotate: bool = False,
                  flush_every_events: int = 8192,
-                 max_events: int = 2_000_000):
+                 max_events: int = 2_000_000,
+                 process_index: int = 0):
         self.path = path
         self.enabled = path is not None
+        self.process_index = process_index
         self._annotate = annotate and self.enabled
         self._flush_every = flush_every_events
         # Hard event budget (~100 bytes/event -> ~200 MB at the
@@ -125,6 +133,21 @@ class Tracer:
             self._file = open(path, "w")
             self._file.write("[\n")
             self._meta("process_name", {"name": process_name})
+            self._meta("process_sort_index",
+                       {"sort_index": process_index})
+            # Per-process clock epoch: a back-to-back (unix wall time,
+            # monotonic span clock) pair.  Event timestamps are
+            # process-local perf_counter microseconds; the aggregator
+            # (obs/aggregate.py) uses this record to shift every
+            # process's events onto one shared wall-clock timeline.
+            perf_us = time.perf_counter_ns() // 1000
+            unix_us = int(time.time() * 1e6)
+            self._push(json.dumps({
+                "name": "trace_epoch", "ph": "i", "s": "g", "cat": "meta",
+                "ts": perf_us, "pid": self._pid, "tid": 0,
+                "args": {"unix_time_us": unix_us,
+                         "perf_time_us": perf_us,
+                         "process_index": process_index}}))
 
     def set_annotate(self, flag: bool):
         """Toggle ``jax.profiler.TraceAnnotation`` wrapping.  An
@@ -164,6 +187,10 @@ class Tracer:
             "args": {k: float(v) for k, v in values.items()}}))
 
     def _complete(self, name, cat, ts, dur, args):
+        # Completed spans also enter the flight recorder's ring
+        # (obs/flightrec.py) — on a crash the unflushed trace tail is
+        # lost, but the ring's copy survives into flightrec.<pid>.json.
+        _flight_recorder().record_span(name, cat, ts, dur)
         # Hot path: format the event line directly — ~5x cheaper than
         # dict + json.dumps, and span names/cats are code literals (the
         # rare quote/backslash falls back to the robust path).

@@ -1,0 +1,239 @@
+"""Watchdog: detect a wedged pipeline thread instead of hanging forever.
+
+At production scale runs fail far more often by *hanging* than by
+diverging: an env subprocess stops answering its pipe, a remote-device
+fetch never returns, a queue hand-off deadlocks — and the process sits
+silent until a human kills it, losing every diagnostic.  The watchdog is
+a heartbeat registry plus one monitor thread:
+
+- Pipeline threads ``touch()`` on progress (actors per env step, both
+  batchers' consumers per batch, the prefetch thread per loop, the
+  learner per update).  A touch is one dict store — no lock, no
+  allocation (bench.py bench_obs measures it as
+  ``obs_watchdog_touch_us``).
+- Event-driven threads ``suspend()`` before blocking on work that may
+  legitimately never arrive (a batcher waiting for requests, the
+  learner waiting on the staged queue) so idleness is never mistaken
+  for a wedge; the NEXT touch re-arms monitoring.
+- The monitor thread flags any armed heartbeat older than
+  ``timeout_s``: it emits the ``stalled_thread`` verdict through the
+  existing ``StallAttributor``/registry one-hots, logs the stale
+  threads with their ages, triggers the flight-recorder dump (ring +
+  all-thread stacks + final metrics snapshot — obs/flightrec.py), and,
+  with ``abort=True``, ends the process (exit code 70) instead of
+  hanging forever.
+
+Driver wiring: ``--watchdog_timeout_s`` (0 disables; see config.py) and
+``--watchdog_abort``.  Library code reaches the process-global instance
+through ``get_watchdog()`` — disabled by default, where ``touch()`` is a
+single no-op method call.
+"""
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from scalable_agent_tpu.obs.flightrec import get_flight_recorder
+from scalable_agent_tpu.obs.registry import MetricsRegistry, get_registry
+from scalable_agent_tpu.obs.stall import StallAttributor
+from scalable_agent_tpu.utils import log
+
+__all__ = ["Watchdog", "configure_watchdog", "get_watchdog"]
+
+_ABORT_EXIT_CODE = 70  # EX_SOFTWARE
+
+
+class Watchdog:
+    """Heartbeat registry + stale-thread monitor.
+
+    ``on_stall(stale)`` (if given) receives ``[(name, age_s), ...]``
+    each time a NEW thread goes stale; a thread that resumes touching
+    re-arms and can be reported again on a later wedge.
+    """
+
+    enabled = True
+
+    def __init__(self, timeout_s: float,
+                 registry: Optional[MetricsRegistry] = None,
+                 poll_interval_s: Optional[float] = None,
+                 on_stall: Optional[Callable] = None,
+                 abort: bool = False,
+                 flight_recorder=None):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0 (use "
+                             "configure_watchdog(0) to disable)")
+        self.timeout_s = float(timeout_s)
+        self._poll_s = poll_interval_s or max(0.05,
+                                              min(1.0, timeout_s / 4.0))
+        self._on_stall = on_stall
+        self._abort = abort
+        self._recorder = flight_recorder
+        registry = registry or get_registry()
+        # The stalled_thread verdict goes through the SAME one-hot
+        # gauges/counters as the interval attribution, so dashboards
+        # watching stall/is_* need no new wiring for the failure case.
+        self._stall = StallAttributor(registry)
+        self._stalls_counter = registry.counter(
+            "watchdog/stalls_total",
+            "threads that missed their heartbeat deadline")
+        self._threads_gauge = registry.gauge(
+            "watchdog/threads", "heartbeats currently armed")
+        self._threads_gauge.set_fn(self._armed_count)
+        registry.gauge("watchdog/timeout_s",
+                       "configured heartbeat deadline").set(self.timeout_s)
+        # name -> (last_touch_monotonic, armed).  Plain dict stores are
+        # atomic in CPython; the monitor iterates over a copy.
+        self._beats: Dict[str, Tuple[float, bool]] = {}
+        self._reported: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- hot path ----------------------------------------------------------
+
+    def touch(self, name: Optional[str] = None):
+        """Record progress for (and arm) this heartbeat."""
+        self._beats[name or threading.current_thread().name] = (
+            time.monotonic(), True)
+
+    def suspend(self, name: Optional[str] = None):
+        """Disarm before blocking on work that may legitimately never
+        arrive — idleness is not a wedge."""
+        self._beats[name or threading.current_thread().name] = (
+            time.monotonic(), False)
+
+    # -- monitor -----------------------------------------------------------
+
+    def _armed_count(self) -> float:
+        return float(sum(1 for _, armed in list(self._beats.values())
+                         if armed))
+
+    def stale_threads(self, now: Optional[float] = None
+                      ) -> List[Tuple[str, float]]:
+        """Armed heartbeats older than the deadline, worst first."""
+        now = time.monotonic() if now is None else now
+        stale = [(name, now - last)
+                 for name, (last, armed) in list(self._beats.items())
+                 if armed and now - last > self.timeout_s]
+        stale.sort(key=lambda item: -item[1])
+        return stale
+
+    def check_once(self) -> List[Tuple[str, float]]:
+        """One monitor pass (the monitor thread calls this every poll
+        interval; tests call it directly).  Fires the stall machinery
+        for heartbeats that went stale since the last pass."""
+        stale = self.stale_threads()
+        stale_names = {name for name, _ in stale}
+        new = stale_names - self._reported
+        # A recovered thread leaves the reported set so a later wedge
+        # of the same thread is reported again.
+        self._reported &= stale_names
+        if new:
+            self._reported |= new
+            self._fire(stale, new_count=len(new))
+        elif stale:
+            # The driver's interval attribution one-hots ITS verdict
+            # each log interval, clearing stalled_thread while the
+            # wedge persists; re-assert the gauges (no recount, no
+            # re-dump) so scrapers can't miss a live stall.
+            self._stall.report_stalled(dict(stale), count=False)
+        return stale
+
+    def _fire(self, stale: List[Tuple[str, float]], new_count: int):
+        # Count only the NEWLY-stale threads: a second thread wedging
+        # later must not re-count the first.
+        self._stalls_counter.inc(new_count)
+        verdict = self._stall.report_stalled(dict(stale))
+        log.error("watchdog: %s (deadline %.1fs) — dumping flight "
+                  "recorder + thread stacks", verdict, self.timeout_s)
+        recorder = self._recorder or get_flight_recorder()
+        recorder.record("stalled_thread", ",".join(n for n, _ in stale),
+                        {"ages_s": {n: round(a, 3) for n, a in stale}})
+        # Bounded dump, same rationale as the signal handler
+        # (flightrec.install_crash_handlers): the dump touches the
+        # tracer lock and the logdir filesystem — either may be the
+        # very resource that wedged the run, and an unbounded inline
+        # dump would block the monitor (and, under abort, block
+        # forever short of the os._exit that exists to end the hang).
+        dumper = threading.Thread(
+            target=recorder.dump_all,
+            args=("watchdog:" + ",".join(name for name, _ in stale),),
+            daemon=True, name="flightrec-dump")
+        dumper.start()
+        dumper.join(timeout=15.0)
+        if self._on_stall is not None:
+            try:
+                self._on_stall(stale)
+            except Exception:
+                log.exception("watchdog on_stall callback failed")
+        if self._abort:
+            log.error("watchdog: aborting the run (exit %d) — artifacts "
+                      "in %s", _ABORT_EXIT_CODE,
+                      recorder.logdir or "<no logdir>")
+            os._exit(_ABORT_EXIT_CODE)
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.check_once()
+                (self._recorder or get_flight_recorder()).record(
+                    "heartbeat_scan", "watchdog",
+                    {"armed": int(self._armed_count())})
+            except Exception:  # the monitor must never die silently
+                log.exception("watchdog monitor pass failed")
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._monitor_loop, daemon=True, name="watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # Unbind the registry callbacks (Gauge.set clears the sampling
+        # fn): a stopped watchdog must not be pinned alive by the
+        # process-global registry, and the post-disarm final metrics
+        # snapshot must not report frozen armed-heartbeat counts.
+        self._threads_gauge.set(0.0)
+
+
+class _DisabledWatchdog:
+    """Null object: instrumented code calls ``touch()`` unconditionally
+    and a disabled watchdog makes that one no-op method call."""
+
+    enabled = False
+    timeout_s = 0.0
+
+    def touch(self, name: Optional[str] = None):
+        pass
+
+    def suspend(self, name: Optional[str] = None):
+        pass
+
+    def stop(self):
+        pass
+
+
+_DISABLED = _DisabledWatchdog()
+_watchdog = _DISABLED
+_watchdog_lock = threading.Lock()
+
+
+def get_watchdog():
+    return _watchdog
+
+
+def configure_watchdog(timeout_s: Optional[float], **kwargs):
+    """Install (and return) the process-global watchdog.  ``None``/``0``
+    stops any live monitor and restores the disabled null object."""
+    global _watchdog
+    with _watchdog_lock:
+        old, _watchdog = _watchdog, _DISABLED
+        old.stop()
+        if timeout_s and timeout_s > 0:
+            _watchdog = Watchdog(timeout_s, **kwargs).start()
+        return _watchdog

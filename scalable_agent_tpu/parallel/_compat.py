@@ -13,4 +13,9 @@ def mark_varying(x, axis_name):
     (needed e.g. for a scan carry that meets a ppermute output)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axis_name, to="varying")
-    return lax.pvary(x, (axis_name,))  # pre-pcast jax
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis_name,))  # pre-pcast jax
+    # Pre-varying-types jax (< 0.4.52): there is no device-variance type
+    # system at all — every value inside shard_map is implicitly
+    # varying, so the marker is a no-op.
+    return x

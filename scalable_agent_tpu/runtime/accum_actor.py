@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from scalable_agent_tpu.envs.vector import MultiEnv
-from scalable_agent_tpu.obs import get_tracer
+from scalable_agent_tpu.obs import get_tracer, get_watchdog
 from scalable_agent_tpu.models.agent import (
     ImpalaAgent,
     actor_step,
@@ -300,7 +300,9 @@ class AccumVectorActor:
         core_state = self._core_state
         bufs = self._bufs
         tracer = get_tracer()
+        watchdog = get_watchdog()
         for slot in range(1, p.unroll_length + 1):
+            watchdog.touch()  # per-step heartbeat: one dict store
             self._counter += 1
             t0 = time.perf_counter()
             # Inference = upload + dispatch + the blocking action fetch
@@ -421,7 +423,9 @@ class GroupedAccumActor:
         first_core = self._core
         core, bufs = self._core, self._bufs
         tracer = get_tracer()
+        watchdog = get_watchdog()
         for slot in range(1, p.unroll_length + 1):
+            watchdog.touch()  # per-step heartbeat: one dict store
             self._counter += 1
             t0 = time.perf_counter()
             with tracer.span("actor/inference", cat="actor",

@@ -36,7 +36,12 @@ import numpy as np
 
 from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step, initial_state
 from scalable_agent_tpu.envs.vector import MultiEnv
-from scalable_agent_tpu.obs import get_registry, get_tracer
+from scalable_agent_tpu.obs import (
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+    get_watchdog,
+)
 from scalable_agent_tpu.types import (
     ActorOutput,
     AgentOutput,
@@ -144,7 +149,9 @@ class VectorActor:
         agent_output = self._last_agent_output
         core_state = self._core_state
         tracer = get_tracer()
+        watchdog = get_watchdog()
         for _ in range(self._unroll_length):
+            watchdog.touch()  # per-step heartbeat: one dict store
             self._step_count += 1
             rng = jax.random.fold_in(self._rng, self._step_count)
             t0 = time.perf_counter()
@@ -483,21 +490,27 @@ class ActorPool:
     # -- run ---------------------------------------------------------------
 
     def _actor_loop(self, actor: VectorActor):
+        recorder = get_flight_recorder()
         try:
             while not self._stop.is_set():
                 # Re-read the global tracer each unroll: the driver may
                 # enable tracing after this thread was born.
                 tracer = get_tracer()
+                watchdog = get_watchdog()
+                watchdog.touch()
                 params = self._get_params()
                 with tracer.span("actor/unroll", cat="actor"):
                     result = actor.run_unroll(params)
                 # Grouped (co-dispatch) actors emit one trajectory per
                 # group per lockstep unroll.
                 items = result if isinstance(result, list) else [result]
+                recorder.record("unroll", actor.level_name or "actor",
+                                {"trajectories": len(items)})
                 for trajectory in items:
                     delivered = False
                     with tracer.span("batcher/queue_put", cat="queue"):
                         while not self._stop.is_set():
+                            watchdog.touch()  # a full queue is not a wedge
                             try:
                                 self.queue.put(trajectory, timeout=0.1)
                                 delivered = True
@@ -505,21 +518,35 @@ class ActorPool:
                             except queue_lib.Full:
                                 continue
                     if delivered:  # shutdown can abandon the put
+                        recorder.record("queue", "put")
                         self._trajectories_counter.inc()
                         self._frames_counter.inc(
                             self._frames_per_trajectory)
         except Exception as exc:  # surface in get_trajectory
             if self._stop.is_set():
                 return  # shutdown cascade (e.g. batcher closed) — benign
+            # The queue hand-off delivers the exception to the driver;
+            # the flight-recorder dump preserves THIS thread's last
+            # moments (ring tail + every thread's stack) even if the
+            # driver never drains it.
+            recorder.record("exception", type(exc).__name__,
+                            {"where": threading.current_thread().name})
+            recorder.dump_all(f"exception:{type(exc).__name__}:"
+                              f"{threading.current_thread().name}")
             self._errors.append(exc)
             self.queue.put(exc)
+        finally:
+            get_watchdog().suspend()
 
     def start(self):
         if self._params is None:
             raise RuntimeError("set_params before start")
-        for actor in self._actors:
+        for i, actor in enumerate(self._actors):
+            # Stable names: watchdog heartbeats, flight-recorder events,
+            # and trace thread tracks all key on the thread name.
             t = threading.Thread(
-                target=self._actor_loop, args=(actor,), daemon=True)
+                target=self._actor_loop, args=(actor,), daemon=True,
+                name=f"actor-{i}")
             t.start()
             self._threads.append(t)
         return self
@@ -527,6 +554,7 @@ class ActorPool:
     def get_trajectory(self, timeout: Optional[float] = None) -> ActorOutput:
         with get_tracer().span("batcher/queue_get", cat="queue"):
             item = self.queue.get(timeout=timeout)
+        get_flight_recorder().record("queue", "get")
         if isinstance(item, Exception):
             raise item
         return item

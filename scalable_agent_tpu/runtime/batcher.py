@@ -36,7 +36,12 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from scalable_agent_tpu.obs import get_registry, get_tracer
+from scalable_agent_tpu.obs import (
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+    get_watchdog,
+)
 from scalable_agent_tpu.types import map_structure
 
 
@@ -178,10 +183,16 @@ class DynamicBatcher:
         return time.monotonic()
 
     def _consume_loop(self):
+        watchdog = get_watchdog()
         while True:
+            # Disarm while blocked awaiting requests — an idle batcher
+            # is not a wedge; re-arm for the batch execution, which IS
+            # bounded work a stale heartbeat should flag.
+            watchdog.suspend()
             batch = self._take_batch()
             if batch is None:
                 return
+            watchdog.touch()
             self._run_batch(batch)
 
     def _pad_rows(self, n: int) -> int:
@@ -211,6 +222,9 @@ class DynamicBatcher:
                 self._latency_hist.observe(done_at - request.enqueued_at)
                 request.future.set_result(row)
         except BaseException as exc:  # propagate to all callers in batch
+            get_flight_recorder().record(
+                "exception", type(exc).__name__,
+                {"where": threading.current_thread().name})
             for request in batch:
                 if not request.future.done():
                     request.future.set_exception(exc)

@@ -33,7 +33,11 @@ import numpy as np
 import optax
 
 from scalable_agent_tpu.models.agent import ImpalaAgent
-from scalable_agent_tpu.obs import get_registry, get_tracer
+from scalable_agent_tpu.obs import (
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+)
 from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
 from scalable_agent_tpu.parallel.mesh import (
@@ -248,7 +252,9 @@ class Learner:
         (reference: experiment.py:531,556-562)."""
         with get_tracer().span("learner/put_trajectory", cat="h2d"), \
                 self._h_put.time():
-            return self._put_trajectory(trajectory)
+            result = self._put_trajectory(trajectory)
+        get_flight_recorder().record("queue", "put_trajectory")
+        return result
 
     def _put_trajectory(self, trajectory: Trajectory) -> Trajectory:
         if jax.process_count() > 1:
@@ -363,4 +369,8 @@ class Learner:
             out = self._update(state, trajectory)
         self._updates_counter.inc()
         self._frames_counter.inc(self._frames_per_update)
+        # Step-number breadcrumb: a crash dump's ring then pins exactly
+        # how far training got, independent of any metrics flush.
+        get_flight_recorder().record(
+            "update", "learner", {"update": int(self._updates_counter.value)})
         return out

@@ -21,7 +21,12 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from scalable_agent_tpu.native import load_library
-from scalable_agent_tpu.obs import get_registry, get_tracer
+from scalable_agent_tpu.obs import (
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+    get_watchdog,
+)
 from scalable_agent_tpu.runtime.batcher import BatcherClosedError
 from scalable_agent_tpu.types import map_structure
 
@@ -240,12 +245,17 @@ class NativeBatcher:
         batch_c = (ctypes.c_char * len(batch_buf)).from_buffer(batch_buf)
         n_c = ctypes.c_int(0)
         id_c = ctypes.c_int64(0)
+        watchdog = get_watchdog()
         while True:
+            # Disarm across the GIL-released native wait (idle is not a
+            # wedge); re-arm for the bounded batch execution.
+            watchdog.suspend()
             status = self._lib.batcher_get_batch(
                 self._handle, ctypes.addressof(batch_c),
                 ctypes.byref(n_c), ctypes.byref(id_c))
             if status == _CLOSED:
                 return
+            watchdog.touch()
             n = n_c.value
             try:
                 self._batch_size_hist.observe(n)
@@ -272,6 +282,11 @@ class NativeBatcher:
                     self._handle, id_c.value, ctypes.addressof(result_c),
                     _OK)
             except BaseException as exc:
+                # The error cascades to callers via the status code; the
+                # ring keeps the native consumer's side of the story.
+                get_flight_recorder().record(
+                    "exception", type(exc).__name__,
+                    {"where": threading.current_thread().name})
                 self._compute_error = exc
                 self._lib.batcher_set_results(
                     self._handle, id_c.value, None, _INVALID)

@@ -104,6 +104,100 @@ class TestRetry:
         assert "e2e_link_probes" not in diag
 
 
+class TestObsRegressionGuard:
+    """Hermetic: synthetic previous-round artifacts in a tmp bench_dir
+    (ISSUE 2 satellite — the obs layer can't silently eat the
+    pipeline)."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_flags_2x_overhead_as_error(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, obs_overhead_frac_on_update=0.001,
+            obs_span_enabled_us=2.0)
+        diag = {"errors": [], "platform": "tpu",
+                "obs_overhead_frac_on_update": 0.0025,
+                "obs_span_enabled_us": 2.1}
+        bench.obs_regression_guard(diag, bench_dir=bench_dir)
+        assert any("OBS REGRESSION" in e
+                   and "obs_overhead_frac_on_update" in e
+                   for e in diag["errors"])
+        # 5% drift on the other key is neither error nor warning.
+        assert not any("obs_span_enabled_us" in e
+                       for e in diag["errors"])
+        assert diag["obs_regression_keys"] == [
+            "obs_overhead_frac_on_update", "obs_span_enabled_us"]
+
+    def test_warns_between_10_and_100_percent(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     obs_flightrec_record_us=1.0)
+        diag = {"errors": [], "platform": "tpu",
+                "obs_flightrec_record_us": 1.5}
+        bench.obs_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+        assert any("obs_flightrec_record_us" in w
+                   for w in diag["warnings"])
+
+    def test_silent_when_previous_round_predates_obs_keys(
+            self, tmp_path):
+        bench_dir = self._write_prev(tmp_path)  # no obs_* keys at all
+        diag = {"errors": [], "platform": "tpu",
+                "obs_overhead_frac_on_update": 0.5}
+        bench.obs_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+        assert "obs_regression_reference" not in diag
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        """CPU-fallback host timings vs the TPU-host artifact measure
+        machine differences, not code — same gate as regression_guard."""
+        bench_dir = self._write_prev(tmp_path,
+                                     obs_watchdog_touch_us=0.5)
+        diag = {"errors": [], "platform": "cpu",
+                "obs_watchdog_touch_us": 1.5}
+        bench.obs_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_is_flagged(
+            self, tmp_path):
+        """A rename/removal of a guarded key must not silently disarm
+        the guard."""
+        bench_dir = self._write_prev(tmp_path,
+                                     obs_flightrec_record_us=1.0,
+                                     obs_watchdog_touch_us=0.5)
+        diag = {"errors": [], "platform": "tpu",
+                "obs_watchdog_touch_us": 0.5}  # flightrec key gone
+        bench.obs_regression_guard(diag, bench_dir=bench_dir)
+        assert any("OBS REGRESSION" in e
+                   and "obs_flightrec_record_us" in e
+                   and "missing this round" in e
+                   for e in diag["errors"])
+        assert diag["obs_regression_keys"] == ["obs_watchdog_touch_us"]
+
+    def test_reads_driver_wrapped_parsed_artifacts(self, tmp_path):
+        wrapped = {"parsed": {
+            "metric": "learner_env_frames_per_sec_per_chip",
+            "platform": "tpu", "obs_watchdog_touch_us": 0.5}}
+        (tmp_path / "BENCH_r08.json").write_text(
+            __import__("json").dumps(wrapped))
+        diag = {"errors": [], "platform": "tpu",
+                "obs_watchdog_touch_us": 2.0}
+        bench.obs_regression_guard(diag, bench_dir=str(tmp_path))
+        assert any("OBS REGRESSION" in e for e in diag["errors"])
+
+    def test_runs_against_real_committed_artifacts(self):
+        """Against the repo's own BENCH_*.json: must never crash, and
+        rounds that predate the obs keys compare nothing."""
+        diag = {"errors": [], "obs_overhead_frac_on_update": 1e-5}
+        bench.obs_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "OBS REGRESSION" in e]
+
+
 class TestRegressionGuard:
     """Runs against the repo's real committed BENCH_r*.json artifact."""
 

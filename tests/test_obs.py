@@ -125,6 +125,53 @@ class TestTracer:
         assert sum(1 for e in events if e["ph"] == "X") <= 5
         assert events[-1]["name"] == "trace_truncated"
 
+    def test_load_tolerates_torn_tail_of_a_crashed_run(self, tmp_path):
+        """A crash mid-write leaves a partial last line (possibly no
+        newline); parsing must yield every complete event and drop the
+        torn one silently."""
+        path = str(tmp_path / "trace.json")
+        with Tracer(path, annotate=False) as tracer:
+            for _ in range(3):
+                with tracer.span("s"):
+                    pass
+        text = open(path).read()
+        cut = text.rstrip()
+        cut = cut[:len(cut) - 17]  # sever the final event mid-JSON
+        with open(path, "w") as f:
+            f.write(cut)
+        events = list(load_trace_events(path))
+        assert events  # the intact head parsed
+        assert all(isinstance(e, dict) for e in events)
+        complete = [e for e in events if e.get("ph") == "X"]
+        assert len(complete) == 2  # the torn third span was dropped
+
+    def test_epoch_record_written_and_parseable(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        with Tracer(path, annotate=False, process_index=5):
+            pass
+        (epoch,) = [e for e in load_trace_events(path)
+                    if e["name"] == "trace_epoch"]
+        args = epoch["args"]
+        assert args["process_index"] == 5
+        # The pair is back-to-back readings of wall and span clocks.
+        assert args["unix_time_us"] > 1e15
+        assert args["perf_time_us"] == epoch["ts"]
+        meta = [e for e in load_trace_events(path)
+                if e["name"] == "process_sort_index"]
+        assert meta and meta[0]["args"]["sort_index"] == 5
+
+    def test_load_parses_strict_closed_arrays_too(self, tmp_path):
+        """The aggregator writes STRICT closed arrays; the same loader
+        must read both formats."""
+        path = str(tmp_path / "merged.json")
+        with open(path, "w") as f:
+            f.write('[\n{"name": "a", "ph": "X", "ts": 1, "dur": 2, '
+                    '"pid": 1, "tid": 1},\n'
+                    '{"name": "b", "ph": "X", "ts": 3, "dur": 4, '
+                    '"pid": 1, "tid": 1}\n]\n')
+        assert [e["name"] for e in load_trace_events(path)
+                if e.get("ph") == "X"] == ["a", "b"]
+
     def test_global_configure_roundtrip(self, tmp_path):
         path = str(tmp_path / "trace.json")
         tracer = obs.configure_tracer(path, annotate=False)
@@ -314,6 +361,42 @@ class TestPrometheusRendering:
         assert open(exporter.path).read() == text
         assert not os.path.exists(exporter.path + ".tmp")
 
+    def test_render_under_concurrent_registry_mutation(self):
+        """Rendering must stay exception-free and well-formed while
+        other threads register instruments and feed observations —
+        the HTTP endpoint renders on scraper threads mid-training."""
+        registry = MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def mutator(slot):
+            i = 0
+            try:
+                while not stop.is_set():
+                    registry.counter(f"m{slot}/c{i % 50}").inc()
+                    registry.histogram(
+                        f"m{slot}/h{i % 50}").observe(i * 1e-3)
+                    registry.gauge(f"m{slot}/g{i % 50}").set(i)
+                    i += 1
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=mutator, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                text = render_prometheus(registry)
+                for line in text.splitlines():
+                    assert line.startswith("#") or len(
+                        line.split()) == 2, line
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors
+
 
 class TestStallAttributor:
     def _observe_actor(self, registry, env_s, infer_s):
@@ -377,6 +460,57 @@ class TestStallAttributor:
         category, evidence = attributor.attribute(0.0, 1.0)
         line = StallAttributor.describe(category, evidence)
         assert "device_bound" in line and "%" in line
+
+    def test_zero_length_interval_is_finite_and_device_bound(self):
+        """A zero-second interval (two log ticks back-to-back) must not
+        divide by zero; with no evidence the verdict defaults to the
+        healthy category with all-zero fractions."""
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        category, evidence = attributor.attribute(0.0, 0.0)
+        assert category == "device_bound"
+        assert evidence["wait_frac"] == 0.0
+        assert evidence["actor_env_frac"] == 0.0
+        snap = registry.snapshot()
+        assert snap["stall/frac_wait_batch"] == 0.0
+        assert snap["stall/frac_update"] == 0.0
+        assert all(np.isfinite(v) for v in evidence.values())
+
+    def test_missing_baseline_histograms_read_zero(self):
+        """Constructing against a registry where the actor histograms
+        were never fed (e.g. ingraph backend: no actor threads) must
+        work — sums start at 0 and stay there."""
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        category, evidence = attributor.attribute(0.9, 0.1)
+        assert category == "learner_starved"  # starved, no env evidence
+        assert evidence["actor_env_s"] == 0.0
+        assert evidence["actor_infer_s"] == 0.0
+
+    def test_all_zero_timings_after_active_interval(self):
+        """An interval in which literally nothing ran (suspended run)
+        must not reuse the previous interval's fractions."""
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        self._observe_actor(registry, env_s=2.0, infer_s=0.5)
+        attributor.attribute(0.8, 0.2)  # active interval
+        category, evidence = attributor.attribute(0.0, 0.0)
+        assert category == "device_bound"
+        assert evidence["actor_env_s"] == 0.0
+
+    def test_report_stalled_one_hots_the_watchdog_verdict(self):
+        registry = MetricsRegistry()
+        attributor = StallAttributor(registry)
+        attributor.attribute(0.9, 0.1)  # a live verdict to displace
+        line = attributor.report_stalled(
+            {"actor-0": 12.34, "prefetch": 45.6})
+        assert "stalled_thread" in line
+        # Worst (longest-silent) thread leads the report.
+        assert line.index("prefetch") < line.index("actor-0")
+        snap = registry.snapshot()
+        assert snap["stall/is_stalled_thread"] == 1.0
+        assert snap["stall/is_learner_starved"] == 0.0
+        assert snap["stall/intervals_stalled_thread_total"] == 1.0
 
 
 class TestTimingSummary:

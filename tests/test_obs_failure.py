@@ -1,0 +1,601 @@
+"""Failure-path observability: flight recorder, crash handlers,
+watchdog, multi-process aggregation, and the live metrics endpoint
+(ISSUE 2)."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from scalable_agent_tpu import obs
+from scalable_agent_tpu.obs import (
+    FlightRecorder,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    PrometheusExporter,
+    Tracer,
+    Watchdog,
+    load_trace_events,
+)
+from scalable_agent_tpu.obs import aggregate
+
+
+@pytest.fixture(autouse=True)
+def _restore_obs_globals():
+    """Tests swap the process-global recorder/watchdog; never leak the
+    configuration into other test modules."""
+    yield
+    obs.configure_watchdog(None)
+    obs.configure_flight_recorder(None)
+
+
+class TestFlightRecorder:
+    def test_ring_drops_oldest_beyond_capacity(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(40):
+            rec.record("step", f"e{i}")
+        events = rec.snapshot()
+        assert len(events) == 16
+        assert events[0]["name"] == "e24"  # oldest surviving
+        assert events[-1]["name"] == "e39"
+
+    def test_dump_roundtrip_with_metrics_snapshot(self, tmp_path):
+        registry = MetricsRegistry()
+        registry.counter("frames_total").inc(7)
+        rec = FlightRecorder(capacity=64, logdir=str(tmp_path),
+                             process_index=3, registry=registry)
+        rec.record("unroll", "fake_level", {"trajectories": 2})
+        path = rec.dump("unit_test")
+        assert path == str(tmp_path / f"flightrec.{os.getpid()}.json")
+        payload = json.load(open(path))
+        assert payload["reason"] == "unit_test"
+        assert payload["process_index"] == 3
+        assert payload["metrics"]["frames_total"] == 7.0
+        assert payload["epoch_unix_us"] > 0
+        (event,) = [e for e in payload["events"] if e["kind"] == "unroll"]
+        assert event["name"] == "fake_level"
+        assert event["args"] == {"trajectories": 2}
+        assert not os.path.exists(path + ".tmp")  # atomic rename
+
+    def test_dump_without_logdir_is_noop(self):
+        rec = FlightRecorder(capacity=8)
+        rec.record("x", "y")
+        assert rec.dump("nowhere") is None
+        assert rec.dump_all("nowhere") is None
+
+    def test_dump_all_writes_stacks_and_prometheus(self, tmp_path):
+        registry = MetricsRegistry()
+        registry.gauge("g").set(1)
+        rec = FlightRecorder(logdir=str(tmp_path), registry=registry)
+        rec.exporter = PrometheusExporter(
+            registry, str(tmp_path / "metrics.prom"))
+        rec.dump_all("forensics")
+        stacks = open(rec.stacks_path()).read()
+        # faulthandler listed this (and every) thread's Python stack.
+        assert "test_dump_all_writes_stacks_and_prometheus" in stacks
+        assert "impala_g 1.0" in open(tmp_path / "metrics.prom").read()
+
+    def test_contended_dump_skips_instead_of_deadlocking(self, tmp_path):
+        """A signal can land mid-dump on the thread holding the dump
+        lock; the nested dump must skip (return None), not block its
+        own thread forever."""
+        rec = FlightRecorder(logdir=str(tmp_path),
+                             registry=MetricsRegistry())
+        assert rec._dump_lock.acquire(blocking=False)
+        try:
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(rec.dump("nested")))
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), "dump blocked on a held lock"
+            assert done == [None]
+        finally:
+            rec._dump_lock.release()
+        # With the lock free the dump proceeds normally.
+        assert rec.dump("after") is not None
+
+    def test_concurrent_dump_all_single_writer(self, tmp_path):
+        """Two failure triggers firing together (watchdog + SIGTERM)
+        must not interleave writes into the same stacks/prom files:
+        the second dump_all skips while one is in flight."""
+        rec = FlightRecorder(logdir=str(tmp_path),
+                             registry=MetricsRegistry())
+        assert rec._dump_all_lock.acquire(blocking=False)
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(rec.dump_all("second")))
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive()
+            assert results == [None]
+        finally:
+            rec._dump_all_lock.release()
+        assert rec.dump_all("after") is not None
+
+    def test_events_carry_the_recording_thread_name(self):
+        rec = FlightRecorder(capacity=8)
+
+        def work():
+            rec.record("probe", "hello")
+
+        t = threading.Thread(target=work, name="actor-7")
+        t.start()
+        t.join()
+        (event,) = rec.snapshot()
+        assert event["thread"] == "actor-7"
+
+    def test_dump_all_flushes_the_tracer_tail(self, tmp_path):
+        """--watchdog_abort os._exits right after dump_all, skipping
+        train()'s finally — the dump itself must flush the tracer's
+        buffered spans or the hang window is lost from the trace."""
+        rec = obs.configure_flight_recorder(str(tmp_path),
+                                            registry=MetricsRegistry())
+        trace_path = str(tmp_path / "t.json")
+        tracer = obs.configure_tracer(trace_path,
+                                      flush_every_events=8192)
+        try:
+            with tracer.span("last/span"):
+                pass
+            assert "last/span" not in open(trace_path).read()  # buffered
+            rec.dump_all("watchdog:actor-0")
+            assert "last/span" in open(trace_path).read()
+        finally:
+            obs.configure_tracer(None)
+
+    def test_span_feed_from_enabled_tracer(self, tmp_path):
+        rec = obs.configure_flight_recorder(None)
+        tracer = obs.configure_tracer(str(tmp_path / "t.json"))
+        try:
+            with tracer.span("learner/update", cat="learner"):
+                pass
+        finally:
+            obs.configure_tracer(None)
+        spans = [e for e in rec.snapshot() if e["kind"] == "span"]
+        assert spans and spans[0]["name"] == "learner/update"
+        assert spans[0]["args"]["cat"] == "learner"
+
+
+class TestCrashHandlers:
+    def test_thread_exception_dumps_and_chains(self, tmp_path):
+        rec = obs.configure_flight_recorder(str(tmp_path))
+        seen = []
+        prev_hook = threading.excepthook
+        threading.excepthook = lambda args: seen.append(args.exc_type)
+        uninstall = obs.install_crash_handlers(rec)
+        try:
+            t = threading.Thread(
+                target=lambda: (_ for _ in ()).throw(
+                    RuntimeError("actor died")),
+                name="actor-1")
+            t.start()
+            t.join()
+        finally:
+            uninstall()
+            threading.excepthook = prev_hook
+        assert seen == [RuntimeError]  # chained to the previous hook
+        payload = json.load(open(rec.dump_path()))
+        assert payload["reason"] == "exception:RuntimeError:actor-1"
+        assert os.path.exists(rec.stacks_path())
+
+    def test_sigterm_dumps_then_raises_systemexit(self, tmp_path):
+        rec = obs.configure_flight_recorder(str(tmp_path))
+        uninstall = obs.install_crash_handlers(
+            rec, handled_signals=(signal.SIGTERM,))
+        try:
+            with pytest.raises(SystemExit) as excinfo:
+                os.kill(os.getpid(), signal.SIGTERM)
+                # The signal is delivered between bytecodes; give the
+                # interpreter a chance to run the handler.
+                for _ in range(100):
+                    time.sleep(0.01)
+        finally:
+            uninstall()
+        assert excinfo.value.code == 128 + signal.SIGTERM
+        payload = json.load(open(rec.dump_path()))
+        assert payload["reason"] == "signal:SIGTERM"
+
+    def test_signal_while_tracer_lock_held_does_not_deadlock(
+            self, tmp_path):
+        """A signal can interrupt the main thread while it holds the
+        tracer's non-reentrant lock (mid Tracer._push); the handler
+        must not dump inline on that thread — it would self-deadlock
+        in get_tracer().flush().  The bounded helper-thread join keeps
+        shutdown moving, and the teardown fallback (clean stack,
+        pending_dump_reason) completes the forensics."""
+        rec = obs.configure_flight_recorder(str(tmp_path))
+        tracer = obs.configure_tracer(str(tmp_path / "t.json"))
+        uninstall = obs.install_crash_handlers(
+            rec, handled_signals=(signal.SIGTERM,))
+        try:
+            with pytest.raises(SystemExit):
+                with tracer._lock:  # the interrupted frame's lock
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    deadline = time.monotonic() + 30
+                    while time.monotonic() < deadline:
+                        time.sleep(0.01)  # handler fires in here
+            # No deadlock: the handler returned within its join bound,
+            # left the fallback breadcrumb, and the ring JSON (written
+            # before the tracer flush step) already exists.
+            assert rec.pending_dump_reason == "signal:SIGTERM"
+            assert os.path.exists(rec.dump_path())
+            # The driver teardown then completes it on a clean stack.
+            # (The handler's helper thread, unblocked by our unwind,
+            # may still hold the single-writer dump_all lock for a
+            # moment — a concurrent teardown dump skips by design.)
+            deadline = time.monotonic() + 5
+            result = None
+            while result is None and time.monotonic() < deadline:
+                result = rec.dump_all(rec.pending_dump_reason)
+                time.sleep(0.01)
+            assert result is not None
+        finally:
+            uninstall()
+            obs.configure_tracer(None)
+
+    def test_uninstall_restores_signal_handler(self, tmp_path):
+        prev = signal.getsignal(signal.SIGTERM)
+        uninstall = obs.install_crash_handlers(
+            obs.configure_flight_recorder(str(tmp_path)))
+        assert signal.getsignal(signal.SIGTERM) is not prev
+        uninstall()
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+
+class TestWatchdog:
+    def test_injected_actor_stall_trips_within_timeout(self, tmp_path):
+        """An actor thread that heartbeats then wedges must trip the
+        watchdog within ~timeout_s and produce the forensic artifacts
+        (ISSUE 2 acceptance)."""
+        registry = MetricsRegistry()
+        rec = FlightRecorder(logdir=str(tmp_path), registry=registry)
+        fired = []
+        wd = Watchdog(timeout_s=0.3, registry=registry,
+                      poll_interval_s=0.05, on_stall=fired.append,
+                      flight_recorder=rec).start()
+        try:
+            wedge = threading.Event()
+
+            def actor_loop():
+                wd.touch()
+                wedge.wait(5)  # env never answers: no further touches
+
+            t = threading.Thread(target=actor_loop, name="actor-0")
+            t.start()
+            deadline = time.monotonic() + 2.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+            elapsed_ok = time.monotonic() < deadline
+            wedge.set()
+            t.join()
+        finally:
+            wd.stop()
+        assert elapsed_ok, "watchdog did not fire within 2s"
+        (stale,) = fired
+        assert stale[0][0] == "actor-0"
+        assert stale[0][1] >= 0.3
+        # Verdict through the registry one-hots + counter.
+        snap = registry.snapshot()
+        assert snap["stall/is_stalled_thread"] == 1.0
+        assert snap["watchdog/stalls_total"] == 1.0
+        # Forensic artifacts: ring dump + all-thread stack dump.
+        payload = json.load(open(rec.dump_path()))
+        assert payload["reason"] == "watchdog:actor-0"
+        assert any(e["kind"] == "stalled_thread"
+                   for e in payload["events"])
+        assert os.path.getsize(rec.stacks_path()) > 0
+
+    def test_suspended_thread_is_not_flagged(self):
+        registry = MetricsRegistry()
+        wd = Watchdog(timeout_s=0.05, registry=registry,
+                      flight_recorder=FlightRecorder())
+        wd.touch("batcher-consumer-0")
+        wd.suspend("batcher-consumer-0")  # idle-waiting, not wedged
+        time.sleep(0.15)
+        assert wd.check_once() == []
+        assert registry.snapshot()["watchdog/stalls_total"] == 0.0
+
+    def test_recovered_thread_can_be_reported_again(self):
+        registry = MetricsRegistry()
+        fired = []
+        wd = Watchdog(timeout_s=0.05, registry=registry,
+                      on_stall=fired.append,
+                      flight_recorder=FlightRecorder())
+        wd.touch("actor-0")
+        time.sleep(0.1)
+        wd.check_once()
+        wd.check_once()  # same stall: reported once, not every poll
+        assert len(fired) == 1
+        wd.touch("actor-0")  # recovery
+        assert wd.check_once() == []
+        time.sleep(0.1)  # second wedge
+        wd.check_once()
+        assert len(fired) == 2
+        assert registry.snapshot()["watchdog/stalls_total"] == 2.0
+
+    def test_second_stall_counts_only_the_new_thread(self):
+        """stalls_total means 'threads that missed their deadline': a
+        second thread wedging later adds 1, not len(all_stale)."""
+        registry = MetricsRegistry()
+        fired = []
+        wd = Watchdog(timeout_s=0.05, registry=registry,
+                      on_stall=fired.append,
+                      flight_recorder=FlightRecorder())
+        wd.touch("actor-0")
+        time.sleep(0.1)
+        wd.check_once()
+        assert registry.snapshot()["watchdog/stalls_total"] == 1.0
+        wd.touch("actor-1")  # second thread arms, then wedges too
+        time.sleep(0.1)
+        wd.check_once()  # actor-0 still stale, actor-1 newly stale
+        assert registry.snapshot()["watchdog/stalls_total"] == 2.0
+        assert len(fired) == 2
+        assert {n for n, _ in fired[1]} == {"actor-0", "actor-1"}
+
+    def test_verdict_reasserted_after_interval_attribution_clears_it(
+            self):
+        """attribute() one-hots its own category each log interval;
+        while the wedge persists the next monitor pass must re-assert
+        stalled_thread (gauges only — no recount, no re-dump)."""
+        from scalable_agent_tpu.obs import StallAttributor
+
+        registry = MetricsRegistry()
+        rec = FlightRecorder()
+        wd = Watchdog(timeout_s=0.05, registry=registry,
+                      flight_recorder=rec)
+        wd.touch("actor-0")
+        time.sleep(0.1)
+        wd.check_once()
+        assert registry.snapshot()["stall/is_stalled_thread"] == 1.0
+        # The driver's interval attribution runs and claims the one-hot.
+        StallAttributor(registry).attribute(0.1, 0.9)
+        assert registry.snapshot()["stall/is_stalled_thread"] == 0.0
+        dumps_before = rec.dump_count
+        wd.check_once()  # same stall, next poll
+        snap = registry.snapshot()
+        assert snap["stall/is_stalled_thread"] == 1.0
+        assert snap["watchdog/stalls_total"] == 1.0  # no recount
+        assert snap["stall/intervals_stalled_thread_total"] == 1.0
+        assert rec.dump_count == dumps_before  # no re-dump either
+
+    def test_armed_count_gauge_and_timeout_gauge(self):
+        registry = MetricsRegistry()
+        wd = Watchdog(timeout_s=7.5, registry=registry,
+                      flight_recorder=FlightRecorder())
+        wd.touch("a")
+        wd.touch("b")
+        wd.suspend("b")
+        snap = registry.snapshot()
+        assert snap["watchdog/threads"] == 1.0
+        assert snap["watchdog/timeout_s"] == 7.5
+
+    def test_configure_zero_restores_disabled_null_object(self):
+        registry = MetricsRegistry()
+        live = obs.configure_watchdog(60.0, registry=registry)
+        assert live.enabled and obs.get_watchdog() is live
+        live.touch("learner")
+        assert registry.snapshot()["watchdog/threads"] == 1.0
+        disabled = obs.configure_watchdog(0)
+        assert not disabled.enabled
+        disabled.touch()  # must be a harmless no-op
+        disabled.suspend()
+        # stop() unbound the gauge callback: the post-disarm final
+        # metrics snapshot must not report frozen armed heartbeats
+        # (and the registry must not pin the dead Watchdog alive).
+        assert registry.snapshot()["watchdog/threads"] == 0.0
+
+
+def _write_trace(path, process_index, unix_epoch_us, events):
+    """Hand-rolled trace file in the tracer's unclosed-array format with
+    a controlled clock epoch."""
+    lines = ["["]
+    lines.append(json.dumps({
+        "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+        "args": {"name": f"proc{process_index}"}}) + ",")
+    lines.append(json.dumps({
+        "name": "trace_epoch", "ph": "i", "s": "g", "cat": "meta",
+        "ts": 0, "pid": os.getpid(), "tid": 0,
+        "args": {"unix_time_us": unix_epoch_us, "perf_time_us": 0,
+                 "process_index": process_index}}) + ",")
+    for event in events:
+        lines.append(json.dumps(event) + ",")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+class TestTraceMerging:
+    def test_merges_and_aligns_two_process_traces(self, tmp_path):
+        # Process 0's clock epoch is 1000 us before process 1's: an
+        # event at local ts=500 in each lands 1000 us apart merged.
+        a = str(tmp_path / "trace.p0.111.json")
+        b = str(tmp_path / "trace.p1.222.json")
+        _write_trace(a, 0, 5_000_000, [
+            {"name": "learner/update", "ph": "X", "cat": "learner",
+             "ts": 500, "dur": 100, "pid": os.getpid(), "tid": 1}])
+        _write_trace(b, 1, 5_001_000, [
+            {"name": "actor/unroll", "ph": "X", "cat": "actor",
+             "ts": 500, "dur": 100, "pid": os.getpid(), "tid": 1}])
+        out = str(tmp_path / "trace.merged.json")
+        summary = aggregate.merge_traces([a, b], out)
+        assert all(i["aligned"] for i in summary["inputs"])
+        # Strict JSON (Perfetto-loadable) AND line-parseable.
+        events = json.load(open(out))
+        assert events == list(load_trace_events(out))
+        spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+        assert spans["learner/update"]["pid"] != (
+            spans["actor/unroll"]["pid"])
+        # Shared wall-clock timeline: p1's identical local ts sits
+        # exactly its epoch delta (1000 us) later.
+        assert (spans["actor/unroll"]["ts"]
+                - spans["learner/update"]["ts"]) == 1000
+        # Every process track is named and ordered.
+        metas = [e for e in events if e.get("ph") == "M"]
+        assert sum(e["name"] == "process_name" for e in metas) == 2
+        assert sum(e["name"] == "process_sort_index" for e in metas) == 2
+
+    def test_traces_from_different_runs_are_flagged(self, tmp_path):
+        """A reused logdir keeps the previous run's pid-suffixed trace
+        alive; a merge spanning runs must be flagged, not silent."""
+        a = str(tmp_path / "trace.p0.111.json")
+        b = str(tmp_path / "trace.p0.222.json")
+        hour_us = 3600 * 1_000_000
+        _write_trace(a, 0, 5_000_000_000, [])
+        _write_trace(b, 0, 5_000_000_000 + hour_us, [])
+        out = str(tmp_path / "m.json")
+        assert aggregate.merge_traces([a, b], out)["multi_run_suspect"]
+        # Same-run spread (seconds) does not flag.
+        _write_trace(b, 1, 5_002_000_000, [])
+        assert not aggregate.merge_traces(
+            [a, b], out)["multi_run_suspect"]
+
+    def test_epochless_trace_merges_unaligned_and_is_flagged(
+            self, tmp_path):
+        a = str(tmp_path / "trace.p0.1.json")
+        with open(a, "w") as f:
+            f.write("[\n" + json.dumps(
+                {"name": "s", "ph": "X", "cat": "c", "ts": 10, "dur": 1,
+                 "pid": 1, "tid": 1}) + ",\n")
+        out = str(tmp_path / "merged.json")
+        summary = aggregate.merge_traces([a], out)
+        assert summary["inputs"][0]["aligned"] is False
+        assert json.load(open(out))
+
+    def test_real_tracer_files_roundtrip_through_merge(self, tmp_path):
+        paths = []
+        for proc in range(2):
+            path = str(tmp_path / f"trace.p{proc}.{os.getpid()}.json")
+            with Tracer(path, process_index=proc) as tracer:
+                with tracer.span(f"work{proc}"):
+                    time.sleep(0.001)
+            paths.append(path)
+        out = str(tmp_path / "trace.merged.json")
+        summary = aggregate.merge_traces(paths, out)
+        assert all(i["aligned"] for i in summary["inputs"])
+        names = {e["name"] for e in json.load(open(out))}
+        assert {"work0", "work1"} <= names
+
+
+class TestPrometheusAggregation:
+    def _texts(self):
+        a = (
+            "# HELP impala_actor_fps frames/s\n"
+            "# TYPE impala_actor_fps gauge\n"
+            "impala_actor_fps 100.0\n"
+            "# TYPE impala_actor_pool_queue_depth gauge\n"
+            "impala_actor_pool_queue_depth 3.0\n"
+            "# TYPE impala_batcher_occupancy gauge\n"
+            "impala_batcher_occupancy 0.5\n"
+            "# TYPE impala_frames_total counter\n"
+            "impala_frames_total 1000.0\n"
+            "# TYPE impala_lat_s summary\n"
+            'impala_lat_s{quantile="0.5"} 0.1\n'
+            "impala_lat_s_sum 5.0\n"
+            "impala_lat_s_count 10\n"
+        )
+        b = a.replace("100.0", "50.0").replace(" 3.0", " 7.0") \
+             .replace("0.5\n", "0.25\n").replace("1000.0", "500.0") \
+             .replace("0.1\n", "0.3\n").replace("5.0\n", "2.0\n") \
+             .replace(" 10\n", " 4\n")
+        return {"0": a, "1": b}
+
+    def test_process_labels_and_fleet_folds(self):
+        text = aggregate.aggregate_prometheus(self._texts())
+        # Per-process series keep their identity.
+        assert 'impala_actor_fps{process="0"} 100.0' in text
+        assert 'impala_actor_fps{process="1"} 50.0' in text
+        # Fleet folds: fps sums, depth maxes, occupancy mins,
+        # counters/summary sums add, quantiles take the worst case.
+        assert 'impala_actor_fps{fold="sum"} 150.0' in text
+        assert 'impala_actor_pool_queue_depth{fold="max"} 7.0' in text
+        assert 'impala_batcher_occupancy{fold="min"} 0.25' in text
+        assert 'impala_frames_total{fold="sum"} 1500.0' in text
+        assert 'impala_lat_s_sum{fold="sum"} 7.0' in text
+        assert 'impala_lat_s_count{fold="sum"} 14.0' in text
+        assert ('impala_lat_s{fold="max",quantile="0.5"} 0.3' in text
+                or 'impala_lat_s{quantile="0.5",fold="max"} 0.3' in text)
+
+    def test_occupancy_summary_quantiles_fold_min(self):
+        """The runtime's occupancy instruments are HISTOGRAMS (summary
+        series with quantile labels); the fleet fold must still answer
+        'who is most starved' — min — not the generic quantile max."""
+        a = ("# TYPE impala_native_batcher_occupancy summary\n"
+             'impala_native_batcher_occupancy{quantile="0.5"} 0.9\n'
+             "impala_native_batcher_occupancy_sum 9.0\n"
+             "impala_native_batcher_occupancy_count 10\n")
+        b = ("# TYPE impala_native_batcher_occupancy summary\n"
+             'impala_native_batcher_occupancy{quantile="0.5"} 0.1\n'
+             "impala_native_batcher_occupancy_sum 1.0\n"
+             "impala_native_batcher_occupancy_count 10\n")
+        text = aggregate.aggregate_prometheus({"0": a, "1": b})
+        # The starved process (0.1) is what the fleet series reports.
+        assert ('impala_native_batcher_occupancy'
+                '{fold="min",quantile="0.5"} 0.1' in text
+                or 'impala_native_batcher_occupancy'
+                '{quantile="0.5",fold="min"} 0.1' in text), text
+        # _sum/_count still add up.
+        assert 'impala_native_batcher_occupancy_sum{fold="sum"} 10.0' \
+            in text
+        assert ('impala_native_batcher_occupancy_count{fold="sum"} 20.0'
+                in text)
+
+    def test_parser_tolerates_torn_tail(self):
+        families = aggregate.parse_prometheus(
+            "# TYPE impala_x counter\nimpala_x 3.0\nimpala_y 1")
+        assert families["impala_x"]["series"][("impala_x", ())] == 3.0
+
+
+class TestAggregateCLI:
+    def test_end_to_end_logdir(self, tmp_path, capsys):
+        logdir = str(tmp_path)
+        for proc in range(2):
+            with Tracer(os.path.join(
+                    logdir, f"trace.p{proc}.{100 + proc}.json"),
+                    process_index=proc) as tracer:
+                with tracer.span("s"):
+                    pass
+        for proc, name in ((0, "metrics.prom"), (1, "metrics.p1.prom")):
+            registry = MetricsRegistry()
+            registry.counter("frames_total").inc(10 * (proc + 1))
+            PrometheusExporter(registry,
+                               os.path.join(logdir, name)).dump()
+        assert aggregate.main([logdir]) == 0
+        merged = os.path.join(logdir, aggregate.MERGED_TRACE_NAME)
+        fleet = os.path.join(logdir, aggregate.FLEET_PROM_NAME)
+        assert json.load(open(merged))
+        text = open(fleet).read()
+        assert 'impala_frames_total{process="0"} 10.0' in text
+        assert 'impala_frames_total{fold="sum"} 30.0' in text
+        # Re-running must not ingest its own outputs.
+        assert aggregate.main([logdir]) == 0
+        traces, proms = aggregate.find_artifacts(logdir)
+        assert len(traces) == 2 and set(proms) == {"0", "1"}
+
+    def test_empty_logdir_fails_cleanly(self, tmp_path, capsys):
+        assert aggregate.main([str(tmp_path)]) == 1
+
+
+class TestMetricsHTTPServer:
+    def test_serves_live_registry_text(self):
+        registry = MetricsRegistry()
+        counter = registry.counter("scrapes_ready")
+        counter.inc(3)
+        with MetricsHTTPServer(registry, port=0) as server:
+            assert server.port > 0
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=5).read().decode()
+            assert "impala_scrapes_ready 3.0" in body
+            # Live, not a snapshot: a later scrape sees the new value.
+            counter.inc()
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/",
+                timeout=5).read().decode()
+            assert "impala_scrapes_ready 4.0" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5)

@@ -6,6 +6,7 @@ verdict (ISSUE 1 acceptance criteria).  Deliberately NOT marked slow —
 this is the fast CI guard that the obs wiring stays alive — so the
 config is the smallest that still crosses every pipeline stage."""
 
+import glob
 import json
 import os
 
@@ -40,9 +41,16 @@ def test_traced_driver_run_emits_trace_and_prometheus(tmp_path):
     assert np.isfinite(metrics["total_loss"])
 
     # -- (a) the Chrome trace ---------------------------------------------
-    trace_path = os.path.join(config.logdir, "trace.json")
-    assert os.path.exists(trace_path)
+    # Per-(process, pid) suffix: two runs sharing a logdir can't clobber
+    # each other (obs/aggregate.py merges multi-process sets).
+    trace_paths = glob.glob(
+        os.path.join(config.logdir, "trace.p0.*.json"))
+    assert len(trace_paths) == 1, trace_paths
+    trace_path = trace_paths[0]
     events = list(load_trace_events(trace_path))
+    # The per-process clock epoch the aggregator aligns timelines with.
+    epochs = [e for e in events if e.get("name") == "trace_epoch"]
+    assert epochs and "unix_time_us" in epochs[0]["args"]
     spans = [e for e in events if e.get("ph") == "X"]
     assert spans, "no complete spans recorded"
     # Well-formed trace events on real (pid, tid) tracks.
@@ -77,13 +85,19 @@ def test_traced_driver_run_emits_trace_and_prometheus(tmp_path):
     assert 'impala_actor_inference_s{quantile="0.5"}' in text
     assert 'impala_learner_put_trajectory_s{quantile="0.5"}' in text
     assert 'quantile="0.99"' in text
-    # Stall-attribution metrics, and exactly one category asserted.
+    # Stall-attribution metrics, and exactly one category asserted
+    # (stalled_thread exists but can't be the one-hot on a healthy run).
     assert "impala_stall_frac_wait_batch" in text
     flags = {
         line.split()[0]: float(line.split()[1])
         for line in text.splitlines()
         if line.startswith("impala_stall_is_")}
-    assert len(flags) == 3 and sum(flags.values()) == 1.0
+    assert len(flags) == 4 and sum(flags.values()) == 1.0
+    assert flags["impala_stall_is_stalled_thread"] == 0.0
+    # The watchdog ran (default-on in the driver) and saw heartbeats
+    # from the pipeline threads without flagging a stall.
+    assert "impala_watchdog_timeout_s 300.0" in text
+    assert "impala_watchdog_stalls_total 0.0" in text
     # Separate actor-vs-learner FPS/frame accounting made it through.
     assert "impala_actor_agent_steps_total" in text
     assert "impala_learner_env_frames_total" in text
