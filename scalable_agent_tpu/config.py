@@ -115,6 +115,23 @@ class Config:
     # device-expressible levels, runtime/ingraph.py — zero per-step
     # host↔device traffic).
     train_backend: str = "host"
+    # Trajectory transport (runtime/transport.py): "packed" flattens
+    # every trajectory leaf into ONE contiguous staging buffer per batch
+    # (dtype-segmented, 128-byte-aligned offsets) so a batch costs a
+    # single H2D copy + a jitted on-device unpack; "per_leaf" is the
+    # seed path — one device_put per leaf — preserved bit-for-bit.
+    # Device-resident trajectories (inference_mode=accum*) bypass the
+    # pack either way: they re-shard on device instead of uploading.
+    transport: str = "packed"
+    # Bounded in-flight dispatch: keep up to this many updates dispatched
+    # but unmaterialized; the driver blocks only when the window is full
+    # (metrics surface when their update falls out of the window).  The
+    # default of 2 overlaps batch k+1's pack/upload with update k while
+    # blocking at most one update behind — the seed loop's effective
+    # pipelining, now with an explicit bound; 1 forces strict lock-step
+    # (a per-update completion wait the seed loop never paid — use it
+    # for debugging, not throughput).
+    inflight_updates: int = 2
     # vtrace: auto | associative | sequential | pallas | time_sharded —
     # auto picks time_sharded when mesh_seq > 1, the fused Pallas kernel
     # on a single-device TPU mesh, associative else.

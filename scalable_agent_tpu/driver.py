@@ -9,6 +9,22 @@ Run:
     python -m scalable_agent_tpu.driver --mode=train \
         --level_name=fake_benchmark --total_environment_frames=100000
     python -m scalable_agent_tpu.driver --mode=test --logdir=...
+
+Transport flags (docs/performance.md, "The trajectory transport"):
+    --transport=packed|per_leaf
+        How host trajectory batches reach the mesh.  ``packed`` (the
+        default) flattens every Trajectory leaf into one contiguous,
+        dtype-segmented, 128-byte-aligned staging buffer — a single H2D
+        copy per batch — and restores the pytree with a jitted on-device
+        unpack; ``per_leaf`` is the seed path (one device_put per leaf),
+        preserved bit-for-bit for golden comparisons.
+    --inflight_updates=W
+        Bounded in-flight dispatch window: the update loop keeps up to W
+        updates dispatched-but-unmaterialized and blocks only when the
+        window is full, so batch k+1's pack/upload overlaps update k on
+        the device.  2 (the default) pipelines one update deep with
+        exact FIFO metrics accounting; 1 forces strict per-update
+        lock-step (debugging, not throughput).
 """
 
 import argparse
@@ -52,6 +68,7 @@ from scalable_agent_tpu.obs import (
 from scalable_agent_tpu.parallel import MeshSpec, make_mesh
 from scalable_agent_tpu.runtime import (
     ActorPool,
+    InflightWindow,
     Learner,
     LearnerHyperparams,
     TrainState,
@@ -597,6 +614,13 @@ def train(config: Config) -> Dict[str, float]:
         # for locating host↔device stalls the Timing counters can't
         # attribute.
         watchdog = get_watchdog()
+        # Bounded in-flight dispatch (runtime/transport.py): up to
+        # --inflight_updates updates stay dispatched-but-unmaterialized;
+        # the loop blocks ("retire") only when the window fills, so the
+        # next batch's staging overlaps the running update while
+        # backpressure and per-update metrics ordering stay exact.
+        inflight = InflightWindow(config.inflight_updates,
+                                  registry=registry)
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
                     and updates - start_updates
@@ -621,13 +645,23 @@ def train(config: Config) -> Dict[str, float]:
             if isinstance(traj, Exception):
                 raise traj
             with timing.time_avg("update"), interval.add_time("update"):
-                state, metrics = learner.update(state, traj)
+                state, dispatched = learner.update(state, traj)
+            inflight.push(dispatched)
             watchdog.touch("learner")
             pool.set_params(state.params, version=updates)
             updates += 1
             frames += frames_per_update
+            if inflight.full:
+                # Materialize the OLDEST in-flight update's metrics
+                # (FIFO, so the logged metrics always belong to a known
+                # update and env_frames accounting is exact); this is
+                # the loop's only device wait.
+                with timing.time_avg("retire"), \
+                        interval.add_time("retire"):
+                    metrics = inflight.retire()
+            watchdog.touch("learner")
             if profiling and updates >= profile_stop_at:
-                jax.block_until_ready(metrics["total_loss"])
+                jax.block_until_ready(dispatched["total_loss"])
                 jax.profiler.stop_trace()
                 get_tracer().set_annotate(False)
                 profiling = False
@@ -636,6 +670,13 @@ def train(config: Config) -> Dict[str, float]:
 
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:
+                if not metrics:
+                    # Nothing has fallen out of the in-flight window
+                    # yet (the first W-1 updates): log the newest
+                    # dispatched update rather than an empty dict —
+                    # the log-time fetch below is the sync the seed
+                    # loop always paid here.
+                    metrics = dispatched
                 host_metrics = {k: _host_scalar(v)
                                 for k, v in metrics.items()}
                 fps = (frames - frames_at_last_log) / (now - last_log)
@@ -699,7 +740,8 @@ def train(config: Config) -> Dict[str, float]:
                 interval.clear()
                 category, evidence = stall.attribute(
                     interval_summary.get("wait_batch", 0.0),
-                    interval_summary.get("update", 0.0))
+                    interval_summary.get("update", 0.0),
+                    retire_s=interval_summary.get("retire", 0.0))
                 if writer is not None:
                     writer.write(updates, host_metrics)
                     writer.write_registry(updates)
@@ -721,6 +763,11 @@ def train(config: Config) -> Dict[str, float]:
         # not read as a stalled_thread wedge — and must never be
         # os._exit'ed mid-checkpoint under --watchdog_abort.
         watchdog.suspend("learner")
+        # Drain the in-flight window so the returned metrics are the
+        # NEWEST update's (the lock-step loop's contract).
+        drained = inflight.drain()
+        if drained is not None:
+            metrics = drained
         ckpt.maybe_save(updates, state, force=True)
         completed = True
     finally:
@@ -767,6 +814,13 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
             f"batch_size {config.batch_size} not divisible by the "
             f"batch-sharding axes data*seq = "
             f"{mesh_data * config.mesh_seq}")
+    if config.transport not in ("packed", "per_leaf"):
+        raise ValueError(
+            f"unknown transport {config.transport!r} (packed | per_leaf)")
+    if config.inflight_updates < 1:
+        raise ValueError(
+            f"inflight_updates must be >= 1, got "
+            f"{config.inflight_updates}")
     if config.mesh_seq > 1 and config.unroll_length % config.mesh_seq:
         raise ValueError(
             f"unroll_length {config.unroll_length} not divisible by "
@@ -791,7 +845,8 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     # The mesh is reachable as learner.mesh; returning just the Learner
     # keeps one source of truth.
     return Learner(agent, hp, mesh, config.frames_per_update(),
-                   scan_impl=config.scan_impl)
+                   scan_impl=config.scan_impl,
+                   transport=config.transport)
 
 
 def train_ingraph(config: Config) -> Dict[str, float]:

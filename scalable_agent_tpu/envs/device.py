@@ -20,9 +20,11 @@ as the zero-simulator-cost throughput benchmark backend (the role of the
 reference's ``doom_benchmark`` spec, envs/doom/doom_utils.py:125-129).
 
 Integer caveat: the host FakeEnv mixes seeds with Python bigints; the
-device mirror uses int32, which is exact for ``seed < 2**31 / 1000003``
-(seed <= 2147 with length_jitter) or ``seed < 2**31 / 131`` (seed <= 16M
-without).  The vectorized constructors check this.
+device mirror uses int32.  The cue/frame arithmetic reduces the seed
+modulo its modulus BEFORE multiplying, so it is exact for ANY int32
+seed; only the length-jitter mix still multiplies the raw seed, so
+jittered envs require ``seed < 2**31 / 1000003`` (seed <= 2147).
+``initial()`` checks the applicable bound.
 """
 
 from typing import NamedTuple, Tuple
@@ -87,8 +89,14 @@ class DeviceFakeEnv:
         self.observation_spec = Observation(
             frame=TensorSpec((height, width, channels), np.uint8, "frame"),
             instruction=None)
-        self._max_seed = (2**31 - 1) // (
-            1000003 if length_jitter > 0 else 131)
+        # Seed bound for exact host-mirror arithmetic: every seed term
+        # in _cue/_frame reduces the seed modulo its modulus BEFORE
+        # multiplying, so any int32 seed is exact there; only the
+        # length-jitter mix still multiplies the raw seed (the host
+        # computes ``seed * 1000003`` in bigints) and keeps the tight
+        # bound.
+        self._max_seed = ((2**31 - 1) // 1000003 if length_jitter > 0
+                          else 2**31 - 1)
 
     # -- pure transition math (mirrors FakeEnv line by line) ---------------
 
@@ -106,9 +114,12 @@ class DeviceFakeEnv:
     def _cue(self, seed, episode, step):
         """Rewarded action index, [B] i32 — term-by-term mod of the
         host's ``(seed*131 + episode*29 [+ step*13]) % A`` (FakeEnv._cue,
-        envs/fake.py): exact vs the host bigints, int32-overflow-free."""
+        envs/fake.py): exact vs the host bigints, int32-overflow-free.
+        The seed is reduced modulo ``a`` BEFORE the multiply —
+        ``(seed * 131) % a`` itself overflows int32 above seed ~16.4M
+        and silently diverged from the host there."""
         a = self.num_actions
-        mix = (seed * 131) % a + (episode % a) * (29 % a)
+        mix = (seed % a) * (131 % a) + (episode % a) * (29 % a)
         if self.reward_mode == "bandit":
             mix = mix + (step % a) * (13 % a)
         return mix % a
@@ -120,7 +131,9 @@ class DeviceFakeEnv:
         episode/step count.  Bandit/memory modes fill with the scaled
         cue instead (FakeEnv._fill_value)."""
         if self.reward_mode == "schedule":
-            base = ((seed * 131) % 251 + (episode % 251) * 17
+            # Same mod-before-multiply discipline as _cue: seed * 131
+            # would overflow int32 above ~16.4M.
+            base = ((seed % 251) * (131 % 251) + (episode % 251) * 17
                     + (step % 251) * 7) % 251
         else:
             scale = 255 // max(1, self.num_actions - 1)

@@ -331,6 +331,13 @@ class RecordingWrapper(Wrapper):
             and name[len("episode_"):].isdigit()
         ]
         self._episode = max(existing, default=-1)
+        # Whether THIS instance has reset yet: the first reset must
+        # always advance past ``_episode`` (which may point at a
+        # previous worker's last recording), while later stepless
+        # resets reuse their number.  Gating the advance on the episode
+        # counter instead conflated the two and made a respawned worker
+        # overwrite the last recorded episode.
+        self._has_reset = False
         self._frames = []
         self._actions = []
         self._rewards = []
@@ -354,10 +361,15 @@ class RecordingWrapper(Wrapper):
                 }, f)
 
     def reset(self):
-        # Advance the episode number only past episodes that actually
-        # stepped — a stepless reset (see _flush) reuses its number, so
-        # recordings are consecutive from episode_00000.
-        if self._episode < 0 or self._actions:
+        # The first reset of THIS instance numbers past whatever is
+        # already on disk (a respawned worker must not overwrite the
+        # previous instance's last episode); after that, advance only
+        # past episodes that actually stepped — a stepless reset (see
+        # _flush) reuses its number, so recordings stay consecutive.
+        if not self._has_reset:
+            self._has_reset = True
+            self._episode += 1
+        elif self._actions:
             self._flush()
             self._episode += 1
         self._frames, self._actions, self._rewards = [], [], []

@@ -65,6 +65,10 @@ class StallAttributor:
         self._frac_update = self._registry.gauge(
             "stall/frac_update",
             "fraction of the learner interval spent in the update")
+        self._frac_retire = self._registry.gauge(
+            "stall/frac_retire",
+            "fraction of the learner interval blocked retiring the "
+            "in-flight update window")
         self._category_gauges = {
             name: self._registry.gauge(
                 f"stall/is_{name}",
@@ -88,12 +92,22 @@ class StallAttributor:
         self._last_env_sum, self._last_infer_sum = env_sum, infer_sum
         return env_d, infer_d
 
-    def attribute(self, wait_batch_s: float, update_s: float
-                  ) -> Tuple[str, Dict[str, float]]:
+    def attribute(self, wait_batch_s: float, update_s: float,
+                  retire_s: float = 0.0) -> Tuple[str, Dict[str, float]]:
         """Classify one interval.  Returns ``(category, fractions)``
-        where fractions carry the evidence for the verdict."""
-        learner_total = wait_batch_s + update_s
+        where fractions carry the evidence for the verdict.
+
+        ``retire_s`` is the in-flight-window stage the async transport
+        added (driver --inflight_updates, runtime/transport.py): time
+        the loop spent blocked materializing an already-dispatched
+        update.  That wait is the DEVICE working through its pipeline —
+        it joins ``update_s`` on the device side of the classification,
+        so a pipelined loop whose dispatch returns instantly still
+        reads ``device_bound`` rather than a phantom starvation."""
+        device_s = update_s + retire_s
+        learner_total = wait_batch_s + device_s
         wait_frac = (wait_batch_s / learner_total) if learner_total else 0.0
+        retire_frac = (retire_s / learner_total) if learner_total else 0.0
         env_s, infer_s = self._actor_interval()
         actor_total = env_s + infer_s
         env_frac = (env_s / actor_total) if actor_total else 0.0
@@ -106,12 +120,19 @@ class StallAttributor:
             category = "learner_starved"
 
         self._frac_wait.set(wait_frac)
-        self._frac_update.set(1.0 - wait_frac if learner_total else 0.0)
+        # The three frac_* gauges partition the learner interval: the
+        # update share must exclude retire time or dashboards summing
+        # them would double-count the in-flight wait.
+        self._frac_update.set(
+            max(0.0, 1.0 - wait_frac - retire_frac)
+            if learner_total else 0.0)
+        self._frac_retire.set(retire_frac)
         for name, gauge in self._category_gauges.items():
             gauge.set(1.0 if name == category else 0.0)
         self._category_counters[category].inc()
         return category, {
             "wait_frac": wait_frac,
+            "retire_frac": retire_frac,
             "actor_env_frac": env_frac,
             "actor_env_s": env_s,
             "actor_infer_s": infer_s,
@@ -140,7 +161,10 @@ class StallAttributor:
     @staticmethod
     def describe(category: str, fractions: Dict[str, float]) -> str:
         """One log line: verdict + the numbers that justify it."""
+        retire = fractions.get("retire_frac", 0.0)
+        retire_part = (f"; inflight retire {retire:.0%}"
+                       if retire else "")
         return (f"pipeline {category} "
                 f"(wait_batch {fractions['wait_frac']:.0%} of learner "
                 f"interval; actor env share "
-                f"{fractions['actor_env_frac']:.0%})")
+                f"{fractions['actor_env_frac']:.0%}{retire_part})")

@@ -14,3 +14,9 @@ from scalable_agent_tpu.runtime.learner import (
     TrainState,
     Trajectory,
 )
+from scalable_agent_tpu.runtime.transport import (
+    InflightWindow,
+    PackedTransport,
+    PerLeafTransport,
+    make_transport,
+)

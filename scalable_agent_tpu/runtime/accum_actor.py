@@ -216,6 +216,24 @@ class AccumPrograms:
         return traj, next_bufs
 
 
+def _h2d_bytes_counter():
+    """The transport layer's shared upload-byte counter (one
+    registration site, runtime/transport.py): the accum actors'
+    per-step uploads and the learner-side packed trajectory staging
+    both feed it."""
+    from scalable_agent_tpu.runtime.transport import h2d_bytes_counter
+
+    return h2d_bytes_counter()
+
+
+def _fields_nbytes(fields) -> int:
+    """Total bytes of one upload's (frame, packed, extras) payload."""
+    import jax
+
+    return sum(np.asarray(leaf).nbytes
+               for leaf in jax.tree_util.tree_leaves(fields))
+
+
 def _upload_fields(programs: AccumPrograms, env_output: StepOutput):
     """One env group's per-step host->device payload: (flat frame bytes,
     packed [4, B] f32, (instruction?, measurements?)).  Validates that
@@ -278,6 +296,7 @@ class AccumVectorActor:
         from scalable_agent_tpu.runtime.actor import actor_stage_histograms
 
         self._h_env, self._h_infer = actor_stage_histograms()
+        self._h2d_bytes = _h2d_bytes_counter()
 
     @staticmethod
     def _flat_frame(env_output: StepOutput) -> np.ndarray:
@@ -285,7 +304,9 @@ class AccumVectorActor:
         return frame.reshape(-1)  # free view; MultiEnv hands a fresh copy
 
     def _upload(self, env_output: StepOutput):
-        return _upload_fields(self._p, env_output)
+        fields = _upload_fields(self._p, env_output)
+        self._h2d_bytes.inc(_fields_nbytes(fields))
+        return fields
 
     def run_unroll(self, params) -> ActorOutput:
         p = self._p
@@ -389,6 +410,7 @@ class GroupedAccumActor:
         from scalable_agent_tpu.runtime.actor import actor_stage_histograms
 
         self._h_env, self._h_infer = actor_stage_histograms()
+        self._h2d_bytes = _h2d_bytes_counter()
 
         # One fused program per phase, vmapped over the group axis.
         # params/counter/slot are shared (in_axes None): lockstep means
@@ -404,8 +426,10 @@ class GroupedAccumActor:
     def _stacked_upload(self):
         frames, packeds, extras = zip(*(
             _upload_fields(self._p, out) for out in self._last_outs))
-        return (np.stack(frames), np.stack(packeds),
-                _stack_group_axis(list(extras)))
+        stacked = (np.stack(frames), np.stack(packeds),
+                   _stack_group_axis(list(extras)))
+        self._h2d_bytes.inc(_fields_nbytes(stacked))
+        return stacked
 
     def run_unroll(self, params):
         """One lockstep unroll -> list of k ActorOutputs (one per

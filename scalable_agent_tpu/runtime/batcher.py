@@ -267,23 +267,16 @@ def _stack_padded(rows, padded: int):
 
 def _unstack(tree, n: int):
     """Split a batched result pytree into n per-row pytrees."""
-    leaves, treedef = _flatten(tree)
+    # The transport module owns the shared flatten/unflatten helpers
+    # (None treated as a leaf) used at every pytree<->rows boundary.
+    from scalable_agent_tpu.runtime.transport import (
+        tree_flatten_with_none,
+        tree_unflatten,
+    )
+
+    leaves, treedef = tree_flatten_with_none(tree)
     rows = []
     for i in range(n):
-        rows.append(treedef_unflatten(treedef, [np.asarray(l)[i]
-                                                for l in leaves]))
+        rows.append(tree_unflatten(treedef, [np.asarray(l)[i]
+                                             for l in leaves]))
     return rows
-
-
-def _flatten(tree):
-    import jax
-
-    leaves, treedef = jax.tree_util.tree_flatten(
-        tree, is_leaf=lambda x: x is None)
-    return leaves, treedef
-
-
-def treedef_unflatten(treedef, leaves):
-    import jax
-
-    return jax.tree_util.tree_unflatten(treedef, leaves)

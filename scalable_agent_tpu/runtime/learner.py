@@ -29,7 +29,6 @@ from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from scalable_agent_tpu.models.agent import ImpalaAgent
@@ -44,6 +43,10 @@ from scalable_agent_tpu.parallel.mesh import (
     batch_sharding,
     model_parallel_shardings,
     replicated_sharding,
+)
+from scalable_agent_tpu.runtime.transport import (
+    broadcast_prefix,
+    make_transport,
 )
 from scalable_agent_tpu.types import AgentOutput, AgentState, StepOutput
 
@@ -86,15 +89,14 @@ class TrainState(NamedTuple):
     env_frames: jax.Array  # f32 scalar, counts frames in exact multiples
 
 
-def _broadcast_prefix(prefix: Trajectory, full: Trajectory):
-    """Expand a per-field sharding prefix tree into a flat list aligned
-    with ``full``'s leaves (None leaves included)."""
-    out = []
-    for sharding, subtree in zip(prefix, full):
-        count = len(jax.tree_util.tree_leaves(
-            subtree, is_leaf=lambda x: x is None))
-        out.extend([sharding] * count)
-    return out
+# Per-field batch-axis positions: agent_state leaves are [B, ...], the
+# [T+1, B, ...] subtrees carry the batch at axis 1.  The transport layer
+# splits/joins the data-sharding axis here.
+_TRAJ_BATCH_AXES = Trajectory(agent_state=0, env_outputs=1,
+                              agent_outputs=1)
+
+# Re-exported for callers that used the private helper here.
+_broadcast_prefix = broadcast_prefix
 
 
 def _make_optimizer(hp: LearnerHyperparams) -> optax.GradientTransformation:
@@ -136,6 +138,7 @@ class Learner:
         mesh,
         frames_per_update: int,
         scan_impl: str = "auto",
+        transport: str = "per_leaf",
     ):
         self._agent = agent
         self._hp = hp
@@ -189,6 +192,12 @@ class Learner:
         self._update = jax.jit(self._update_impl, donate_argnums=(0,))
         self._replicated = replicated
         self._traj_shardings = traj_shardings
+        # Host->device trajectory placement strategy: "per_leaf" (one
+        # device_put per leaf — the seed path, bit-for-bit preserved) or
+        # "packed" (single-copy H2D + jitted on-device unpack,
+        # runtime/transport.py).
+        self._transport = make_transport(
+            transport, mesh, traj_shardings, _TRAJ_BATCH_AXES)
         registry = get_registry()
         self._h_put = registry.histogram(
             "learner/put_trajectory_s",
@@ -252,26 +261,9 @@ class Learner:
         (reference: experiment.py:531,556-562)."""
         with get_tracer().span("learner/put_trajectory", cat="h2d"), \
                 self._h_put.time():
-            result = self._put_trajectory(trajectory)
+            result = self._transport.put(trajectory)
         get_flight_recorder().record("queue", "put_trajectory")
         return result
-
-    def _put_trajectory(self, trajectory: Trajectory) -> Trajectory:
-        if jax.process_count() > 1:
-            def build(sharding, local):
-                return jax.make_array_from_process_local_data(
-                    sharding, np.asarray(local))
-
-            shardings_flat = _broadcast_prefix(
-                self._traj_shardings, trajectory)
-            leaves, treedef = jax.tree_util.tree_flatten(
-                trajectory, is_leaf=lambda x: x is None)
-            placed = [
-                None if leaf is None else build(sh, leaf)
-                for sh, leaf in zip(shardings_flat, leaves)
-            ]
-            return jax.tree_util.tree_unflatten(treedef, placed)
-        return jax.device_put(trajectory, self._traj_shardings)
 
     # -- update -----------------------------------------------------------
 

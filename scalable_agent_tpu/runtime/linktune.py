@@ -40,6 +40,20 @@ class LinkProfile(NamedTuple):
     h2d_bytes_per_s: float
 
 
+# RTT-jitter guards for the bandwidth estimate: the measured upload
+# window includes one fetch round trip, so the RTT is subtracted before
+# dividing — but RTT jitter can make ``upload_s - rtt_s`` collapse to
+# (or below) zero, and an unclamped division then reports ~8e15 B/s,
+# falsely clearing any bandwidth gate (bench.py's 300 MB/s e2e retry
+# threshold).  The transfer window is therefore floored at this fraction
+# of the whole upload window (an RTT-dominated measurement can still
+# only certify ~1/frac x the naive bytes/window estimate)...
+MIN_TRANSFER_FRAC = 0.1
+# ...and the reported bandwidth is capped outright: no host link this
+# probe runs over moves more than this, so anything above it is jitter,
+# not wire.
+MAX_H2D_BYTES_PER_S = 64e9
+
 # Env stepping cost per group-step: ~9 ms measured for the bench fleet
 # on the 1-core host (BENCH_NOTES r3 link characterization).  It enters
 # the model additively and identically for every shard count, so the
@@ -64,6 +78,10 @@ def probe_link(device=None, upload_bytes: int = 8 << 20) -> LinkProfile:
     that, a 67 ms-RTT link reads at most upload_bytes/RTT (~250 MB/s
     for 16 MB) no matter how fast the wire is, and any
     bandwidth-threshold consumer silently saturates below its gate.
+    The subtraction is clamped (``MIN_TRANSFER_FRAC``/
+    ``MAX_H2D_BYTES_PER_S``): RTT jitter between the RTT probes and the
+    upload window can otherwise drive the denominator to the float
+    floor and report physically impossible bandwidth.
     Cost: ~2x RTT-bound seconds on a degraded tunnel, ~ms co-located.
     """
     import jax
@@ -83,8 +101,20 @@ def probe_link(device=None, upload_bytes: int = 8 << 20) -> LinkProfile:
     upload_s = time.perf_counter() - t0
     return LinkProfile(
         rtt_s=rtt_s,
-        h2d_bytes_per_s=upload_bytes / max(upload_s - rtt_s, 1e-9),
+        h2d_bytes_per_s=_clamped_bandwidth(upload_bytes, upload_s,
+                                           rtt_s),
     )
+
+
+def _clamped_bandwidth(upload_bytes: int, upload_s: float,
+                       rtt_s: float) -> float:
+    """RTT-corrected H2D bandwidth with jitter guards: the transfer
+    window never shrinks below ``MIN_TRANSFER_FRAC`` of the measured
+    upload window, and the result never exceeds
+    ``MAX_H2D_BYTES_PER_S``."""
+    transfer_s = max(upload_s - rtt_s, MIN_TRANSFER_FRAC * upload_s,
+                     1e-9)
+    return min(upload_bytes / transfer_s, MAX_H2D_BYTES_PER_S)
 
 
 def predicted_fused_fps(

@@ -16,7 +16,7 @@ subgraph in the reference (dynamic_batching.py:131-144).
 import ctypes
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -28,100 +28,14 @@ from scalable_agent_tpu.obs import (
     get_watchdog,
 )
 from scalable_agent_tpu.runtime.batcher import BatcherClosedError
+# One flat-pytree byte layout serves every host-side pytree<->bytes
+# boundary (this batcher's request/result rows and the packed trajectory
+# transport's segments) — runtime/transport.py is the single source of
+# truth for offsets/shape/dtype bookkeeping.
+from scalable_agent_tpu.runtime.transport import FlatRowLayout as _Layout
 from scalable_agent_tpu.types import map_structure
 
 _OK, _CLOSED, _TIMEOUT, _INVALID = 0, 1, 2, 3
-
-
-class _Layout:
-    """Flattened pytree layout: per-leaf (offset, shape, dtype)."""
-
-    def __init__(self, example):
-        import jax
-
-        leaves, self.treedef = jax.tree_util.tree_flatten(
-            example, is_leaf=lambda x: x is None)
-        # A None leaf (e.g. an absent optional observation) contributes
-        # zero bytes and round-trips as None.
-        self.fields: List[Optional[
-            Tuple[int, Tuple[int, ...], np.dtype]]] = []
-        offset = 0
-        for leaf in leaves:
-            if leaf is None:
-                self.fields.append(None)
-                continue
-            arr = np.asarray(leaf)
-            self.fields.append((offset, arr.shape, arr.dtype))
-            offset += arr.nbytes
-        self.nbytes = offset
-
-    def pack_into(self, buf: memoryview, tree) -> None:
-        import jax
-
-        leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
-        for field, leaf in zip(self.fields, leaves):
-            if field is None:
-                continue
-            offset, shape, dtype = field
-            # No ascontiguousarray here: it would promote 0-d leaves to
-            # 1-d, and tobytes() already emits C-order bytes.
-            arr = np.asarray(leaf, dtype=dtype)
-            if arr.shape != shape:
-                raise ValueError(
-                    f"leaf shape {arr.shape} != declared {shape}")
-            buf[offset:offset + arr.nbytes] = arr.tobytes()
-
-    def unpack_rows(self, buf: memoryview, n: int):
-        """[n, nbytes] packed rows -> pytree of [n, ...] arrays."""
-        import jax
-
-        flat = np.frombuffer(buf, np.uint8,
-                             count=n * self.nbytes).reshape(n, self.nbytes)
-        leaves = []
-        for field in self.fields:
-            if field is None:
-                leaves.append(None)
-                continue
-            offset, shape, dtype = field
-            nbytes = int(np.prod(shape)) * dtype.itemsize
-            chunk = np.ascontiguousarray(flat[:, offset:offset + nbytes])
-            leaves.append(chunk.view(dtype).reshape((n,) + shape))
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
-
-    def pack_rows(self, buf: memoryview, tree, n: int) -> None:
-        """pytree of [>=n, ...] arrays -> [n, nbytes] packed rows."""
-        import jax
-
-        leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
-        flat = np.frombuffer(buf, np.uint8,
-                             count=n * self.nbytes).reshape(n, self.nbytes)
-        # frombuffer on a writable memoryview yields a writable view.
-        for field, leaf in zip(self.fields, leaves):
-            if field is None:
-                continue
-            offset, shape, dtype = field
-            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype)[:n])
-            nbytes = int(np.prod(shape)) * dtype.itemsize
-            # View as bytes BEFORE reshaping: reshape counts elements, so
-            # reshaping the typed array to byte-count columns blows up for
-            # any leaf with >1 element per row.
-            flat[:, offset:offset + nbytes] = (
-                arr.view(np.uint8).reshape(n, nbytes))
-
-    def unpack_one(self, buf: memoryview):
-        import jax
-
-        leaves = []
-        for field in self.fields:
-            if field is None:
-                leaves.append(None)
-                continue
-            offset, shape, dtype = field
-            nbytes = int(np.prod(shape)) * dtype.itemsize
-            arr = np.frombuffer(buf, np.uint8, count=nbytes,
-                                offset=offset).view(dtype).reshape(shape)
-            leaves.append(arr.copy())
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
 class NativeBatcher:

@@ -1,0 +1,605 @@
+"""Asynchronous trajectory transport: host batches onto the mesh.
+
+The host training pipeline's binding constraint is the actor→learner
+hand-off, not the chip (BENCH_r05: the learner alone sustains ~2.7M
+env-frames/s while the host pipeline delivers 8.6-16.4k).  Three layers
+in this module attack it:
+
+- **Packed single-copy H2D** (``PackedTransport``): every Trajectory
+  leaf is flattened into ONE contiguous host buffer per batch —
+  dtype-segmented, 128-byte-aligned offsets — so a batch costs a single
+  H2D copy instead of a per-leaf ``device_put`` storm (flat-bytes upload
+  is an order of magnitude cheaper over some transports; see
+  runtime/accum_actor.py's per-step frame upload).  A jitted on-device
+  unpack (bitcast + slice + reshape) restores the pytree, sharded over
+  the mesh's batch axes; multi-host runs assemble the global buffer from
+  per-process rows via ``make_array_from_process_local_data``.
+- **Double-buffered staging**: two preallocated staging buffers rotate,
+  so packing batch k+1 can overwrite host memory while batch k's
+  (asynchronous) upload is still in flight.
+- **Bounded in-flight dispatch** (``InflightWindow``): the driver keeps
+  up to W updates in flight and blocks only when the window is full —
+  metrics are materialized when their update falls out of the window —
+  turning the update loop from lock-step into a pipeline with explicit
+  backpressure.
+
+``PerLeafTransport`` preserves the original per-leaf placement path
+bit-for-bit (``--transport=per_leaf``); ``make_transport`` dispatches on
+the config string.  The module also hosts ``FlatRowLayout``, the shared
+flat-pytree byte layout the native batcher packs requests with (one
+layout implementation for every host-side pytree<->bytes boundary).
+"""
+
+import threading
+from collections import deque
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.obs import get_registry, get_tracer
+
+__all__ = [
+    "FlatRowLayout",
+    "InflightWindow",
+    "PackedTransport",
+    "PerLeafTransport",
+    "broadcast_prefix",
+    "h2d_bytes_counter",
+    "make_transport",
+    "tree_flatten_with_none",
+    "tree_unflatten",
+]
+
+
+def h2d_bytes_counter():
+    """The transport layer's shared upload-byte counter: the packed
+    trajectory staging here and the accum actors' per-step uploads
+    (runtime/accum_actor.py) both feed it, so ``transport/
+    h2d_bytes_total`` is the host->device byte rate of the whole
+    pipeline."""
+    return get_registry().counter(
+        "transport/h2d_bytes_total",
+        "host->device bytes staged by the transport layer (packed "
+        "trajectory batches + accum per-step uploads)")
+
+# Leaf offsets inside a packed shard segment are rounded up to this many
+# bytes: wide enough for any dtype's alignment and for efficient DMA
+# engines, small enough that padding stays negligible next to the frame
+# leaf (the alignment loss is < num_leaves * 128 bytes per shard).
+_ALIGN = 128
+
+
+def tree_flatten_with_none(tree):
+    """``tree_flatten`` with None treated as a leaf — the convention at
+    every pytree<->rows boundary in the runtime (absent optional
+    observations round-trip as None)."""
+    import jax
+
+    return jax.tree_util.tree_flatten(tree, is_leaf=lambda x: x is None)
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
+
+
+def tree_unflatten(treedef, leaves):
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# Internal aliases (the public names are the API).
+_tree_flatten = tree_flatten_with_none
+_tree_unflatten = tree_unflatten
+
+
+def broadcast_prefix(prefix, full) -> List[Any]:
+    """Expand a per-field prefix tree (one entry per top-level field of
+    ``full``) into a flat list aligned with ``full``'s leaves (None
+    leaves included)."""
+    out = []
+    for entry, subtree in zip(prefix, full):
+        out.extend([entry] * len(_tree_leaves(subtree)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FlatRowLayout: unaligned flat pytree <-> bytes (the native batcher's
+# request/result rows; alignment there is fixed by the C++ core's
+# byte-blob contract, so offsets pack densely).
+# ---------------------------------------------------------------------------
+
+
+class FlatRowLayout:
+    """Flattened pytree layout: per-leaf (offset, shape, dtype).
+
+    A None leaf (e.g. an absent optional observation) contributes zero
+    bytes and round-trips as None.
+    """
+
+    def __init__(self, example):
+        leaves, self.treedef = _tree_flatten(example)
+        self.fields: List[Optional[
+            Tuple[int, Tuple[int, ...], np.dtype]]] = []
+        offset = 0
+        for leaf in leaves:
+            if leaf is None:
+                self.fields.append(None)
+                continue
+            arr = np.asarray(leaf)
+            self.fields.append((offset, arr.shape, arr.dtype))
+            offset += arr.nbytes
+        self.nbytes = offset
+
+    def pack_into(self, buf: memoryview, tree) -> None:
+        leaves = _tree_leaves(tree)
+        for field, leaf in zip(self.fields, leaves):
+            if field is None:
+                continue
+            offset, shape, dtype = field
+            # No ascontiguousarray here: it would promote 0-d leaves to
+            # 1-d, and tobytes() already emits C-order bytes.
+            arr = np.asarray(leaf, dtype=dtype)
+            if arr.shape != shape:
+                raise ValueError(
+                    f"leaf shape {arr.shape} != declared {shape}")
+            buf[offset:offset + arr.nbytes] = arr.tobytes()
+
+    def unpack_rows(self, buf: memoryview, n: int):
+        """[n, nbytes] packed rows -> pytree of [n, ...] arrays."""
+        flat = np.frombuffer(buf, np.uint8,
+                             count=n * self.nbytes).reshape(n, self.nbytes)
+        leaves = []
+        for field in self.fields:
+            if field is None:
+                leaves.append(None)
+                continue
+            offset, shape, dtype = field
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            chunk = np.ascontiguousarray(flat[:, offset:offset + nbytes])
+            leaves.append(chunk.view(dtype).reshape((n,) + shape))
+        return _tree_unflatten(self.treedef, leaves)
+
+    def pack_rows(self, buf: memoryview, tree, n: int) -> None:
+        """pytree of [>=n, ...] arrays -> [n, nbytes] packed rows."""
+        leaves = _tree_leaves(tree)
+        flat = np.frombuffer(buf, np.uint8,
+                             count=n * self.nbytes).reshape(n, self.nbytes)
+        # frombuffer on a writable memoryview yields a writable view.
+        for field, leaf in zip(self.fields, leaves):
+            if field is None:
+                continue
+            offset, shape, dtype = field
+            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype)[:n])
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            # View as bytes BEFORE reshaping: reshape counts elements, so
+            # reshaping the typed array to byte-count columns blows up for
+            # any leaf with >1 element per row.
+            flat[:, offset:offset + nbytes] = (
+                arr.view(np.uint8).reshape(n, nbytes))
+
+    def unpack_one(self, buf: memoryview):
+        leaves = []
+        for field in self.fields:
+            if field is None:
+                leaves.append(None)
+                continue
+            offset, shape, dtype = field
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            arr = np.frombuffer(buf, np.uint8, count=nbytes,
+                                offset=offset).view(dtype).reshape(shape)
+            leaves.append(arr.copy())
+        return _tree_unflatten(self.treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Per-leaf transport: the original placement path, preserved verbatim.
+# ---------------------------------------------------------------------------
+
+
+class PerLeafTransport:
+    """Place every trajectory leaf with its own ``device_put`` (or
+    ``make_array_from_process_local_data`` in multi-host runs).  This is
+    the seed behavior, kept bit-for-bit for ``--transport=per_leaf`` and
+    as the fallback for trajectories whose leaves already live on device
+    (the accum actor paths, where re-placement is a cheap device-side
+    reshard, not an upload)."""
+
+    def __init__(self, mesh, shardings_prefix):
+        self._mesh = mesh
+        self._shardings_prefix = shardings_prefix
+
+    def put(self, trajectory):
+        import jax
+
+        if jax.process_count() > 1:
+            def build(sharding, local):
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(local))
+
+            shardings_flat = broadcast_prefix(
+                self._shardings_prefix, trajectory)
+            leaves, treedef = _tree_flatten(trajectory)
+            placed = [
+                None if leaf is None else build(sh, leaf)
+                for sh, leaf in zip(shardings_flat, leaves)
+            ]
+            return _tree_unflatten(treedef, placed)
+        return jax.device_put(trajectory, self._shardings_prefix)
+
+
+# ---------------------------------------------------------------------------
+# Packed transport.
+# ---------------------------------------------------------------------------
+
+
+class _LeafSpec(NamedTuple):
+    """One leaf's slot inside a packed shard segment."""
+
+    offset: int  # byte offset within a shard segment (128-aligned)
+    nbytes: int  # bytes of ONE shard's chunk of this leaf
+    shape: Tuple[int, ...]  # GLOBAL leaf shape (what unpack emits)
+    local_shape: Tuple[int, ...]  # this process's leaf shape (pack input)
+    chunk_shape: Tuple[int, ...]  # shape with the batch axis / num_shards
+    dtype: np.dtype
+    batch_axis: int
+
+
+def _round_up(n: int, align: int) -> int:
+    return (n + align - 1) // align * align
+
+
+class PackedSpec:
+    """The byte layout of one packed trajectory batch.
+
+    Leaves are ordered dtype-segmented (stable within a dtype) and each
+    gets a 128-byte-aligned offset inside the per-shard segment; the
+    host buffer is ``[num_shards, shard_nbytes]`` uint8, where shard d
+    holds batch slice ``[d*b:(d+1)*b]`` of every leaf — so uploading the
+    buffer sharded over its leading axis lands each device's batch
+    shard directly on that device.  In multi-host runs the example is
+    the process-LOCAL batch (1/P of the global batch, matching the
+    per-leaf path's ``make_array_from_process_local_data`` contract)
+    and each process packs its own ``local_shards`` rows.
+    """
+
+    def __init__(self, example, batch_axes_prefix, num_shards: int,
+                 local_shards: Optional[int] = None):
+        leaves, self.treedef = _tree_flatten(example)
+        batch_axes = broadcast_prefix(batch_axes_prefix, example)
+        self.num_shards = int(num_shards)
+        self.local_shards = int(local_shards or num_shards)
+        self.specs: List[Optional[_LeafSpec]] = [None] * len(leaves)
+        # dtype-segmented: leaves of one dtype pack adjacently, so the
+        # alignment padding between same-dtype leaves is bounded by the
+        # 128-byte rounding alone (and the unpack's bitcasts cluster).
+        order = sorted(
+            (i for i, leaf in enumerate(leaves) if leaf is not None),
+            key=lambda i: (np.asarray(leaves[i]).dtype.str, i))
+        offset = 0
+        for i in order:
+            arr = np.asarray(leaves[i])
+            axis = batch_axes[i]
+            local_batch = arr.shape[axis]
+            if local_batch % self.local_shards:
+                raise ValueError(
+                    f"batch axis {axis} of leaf shape {arr.shape} "
+                    f"({local_batch}) not divisible by "
+                    f"{self.local_shards} local data shards")
+            chunk = local_batch // self.local_shards
+            chunk_shape = (arr.shape[:axis] + (chunk,)
+                           + arr.shape[axis + 1:])
+            global_shape = (arr.shape[:axis]
+                            + (chunk * self.num_shards,)
+                            + arr.shape[axis + 1:])
+            nbytes = int(np.prod(chunk_shape)) * arr.dtype.itemsize
+            offset = _round_up(offset, _ALIGN)
+            self.specs[i] = _LeafSpec(
+                offset=offset, nbytes=nbytes, shape=global_shape,
+                local_shape=arr.shape, chunk_shape=chunk_shape,
+                dtype=arr.dtype, batch_axis=axis)
+            offset += nbytes
+        self.shard_nbytes = _round_up(offset, _ALIGN)
+
+    def pack_into(self, buf: np.ndarray, trajectory) -> None:
+        """Write the local trajectory's leaves into ``buf``
+        ([local_shards, shard_nbytes] uint8): row d holds batch chunk d
+        of every leaf, leaf bytes at their aligned offsets."""
+        leaves = _tree_leaves(trajectory)
+        if len(leaves) != len(self.specs):
+            raise ValueError(
+                f"trajectory has {len(leaves)} leaves, layout declares "
+                f"{len(self.specs)}")
+        for spec, leaf in zip(self.specs, leaves):
+            if spec is None:
+                if leaf is not None:
+                    raise ValueError(
+                        "trajectory leaf present where the layout "
+                        "declares None")
+                continue
+            arr = np.asarray(leaf)
+            if arr.dtype != spec.dtype:
+                raise ValueError(
+                    f"leaf dtype {arr.dtype} != declared {spec.dtype}")
+            if arr.shape != spec.local_shape:
+                raise ValueError(
+                    f"leaf shape {arr.shape} != declared "
+                    f"{spec.local_shape}")
+            axis = spec.batch_axis
+            pre, post = arr.shape[:axis], arr.shape[axis + 1:]
+            b = arr.shape[axis] // self.local_shards
+            split = arr.reshape(pre + (self.local_shards, b) + post)
+            moved = np.moveaxis(split, axis, 0)  # [shards, *pre, b, *post]
+            dest = buf[:, spec.offset:spec.offset + spec.nbytes]
+            dest = dest.view(spec.dtype).reshape(moved.shape)
+            np.copyto(dest, moved)
+
+
+class PackedTransport:
+    """Single-copy H2D trajectory placement with double-buffered staging.
+
+    ``put(trajectory)`` returns the same device-resident, mesh-sharded
+    Trajectory the per-leaf path produces — bit-for-bit identical leaf
+    values — but pays one contiguous upload per batch.  The layout is
+    derived lazily from the first trajectory (shapes/dtypes are a
+    runtime property of the env).  Trajectories whose leaves already
+    live on device (accum actor paths) fall through to the per-leaf
+    re-shard: packing them would FETCH device memory back to the host.
+    """
+
+    def __init__(self, mesh, shardings_prefix, batch_axes_prefix):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self._mesh = mesh
+        self._shardings_prefix = shardings_prefix
+        self._batch_axes_prefix = batch_axes_prefix
+        self._per_leaf = PerLeafTransport(mesh, shardings_prefix)
+        # The batch dimension shards over (data, seq) — parallel/mesh.py
+        # batch_sharding — so the packed buffer's shard axis must too.
+        batch_axes = (("data", "seq") if "seq" in mesh.shape
+                      else ("data",))
+        self._num_shards = 1
+        for name in batch_axes:
+            self._num_shards *= mesh.shape.get(name, 1)
+        self._buf_sharding = NamedSharding(
+            mesh, PartitionSpec(batch_axes, None))
+        self._spec: Optional[PackedSpec] = None
+        self._unpack_jit = None
+        # Double-buffered staging: pack k+1 while k's async upload is in
+        # flight.  ``_upload_done[slot]`` holds the device buffer of the
+        # LAST upload out of that slot: ``device_put`` from a numpy
+        # array may read the host memory until the transfer completes
+        # (PJRT immutable-until-transfer semantics), so a pack reusing a
+        # slot first blocks on that slot's previous upload — with two
+        # buffers that wait targets upload k-1 and is normally already
+        # satisfied, making the common case wait-free.  The lock covers
+        # only slot rotation and the completion bookkeeping — pack_into
+        # runs outside it — so the transport supports ONE packing
+        # caller at a time (the driver's single prefetch thread); a
+        # third concurrent put() could reclaim a slot another caller
+        # is still packing.
+        self._staging: List[Optional[np.ndarray]] = [None, None]
+        self._upload_done: List[Optional[object]] = [None, None]
+        self._slot = 0
+        self._lock = threading.Lock()
+        self._local_shards = self._num_shards // jax.process_count()
+        if self._num_shards % jax.process_count():
+            raise ValueError(
+                f"{self._num_shards} batch shards not divisible by "
+                f"{jax.process_count()} processes")
+        registry = get_registry()
+        self._h_pack = registry.histogram(
+            "transport/pack_s", "host pack into the staging buffer")
+        self._h_upload = registry.histogram(
+            "transport/upload_s", "single-copy H2D dispatch seconds")
+        self._h_unpack = registry.histogram(
+            "transport/unpack_s", "on-device unpack dispatch seconds")
+        self._bytes_counter = h2d_bytes_counter()
+
+    # -- layout ------------------------------------------------------------
+
+    def _ensure_spec(self, trajectory):
+        if self._spec is None:
+            # The example is the LOCAL batch; the global layout scales
+            # its batch axes by the process count.
+            self._spec = PackedSpec(
+                trajectory, self._batch_axes_prefix,
+                num_shards=self._num_shards,
+                local_shards=self._local_shards)
+            self._unpack_jit = self._build_unpack()
+        return self._spec
+
+    def _build_unpack(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self._spec
+        shardings_flat = broadcast_prefix(
+            self._shardings_prefix,
+            _tree_unflatten(spec.treedef,
+                            [None if s is None else 0
+                             for s in spec.specs]))
+        d = spec.num_shards
+
+        def unpack(buf):
+            leaves = []
+            for leaf_spec, sharding in zip(spec.specs, shardings_flat):
+                if leaf_spec is None:
+                    leaves.append(None)
+                    continue
+                itemsize = leaf_spec.dtype.itemsize
+                count = leaf_spec.nbytes // itemsize
+                seg = jax.lax.slice_in_dim(
+                    buf, leaf_spec.offset,
+                    leaf_spec.offset + leaf_spec.nbytes, axis=1)
+                if leaf_spec.dtype == np.bool_:
+                    flat = seg != 0  # bitcast to bool is unsupported
+                elif itemsize == 1:
+                    flat = (seg if leaf_spec.dtype == np.uint8
+                            else jax.lax.bitcast_convert_type(
+                                seg, jnp.dtype(leaf_spec.dtype)))
+                else:
+                    flat = jax.lax.bitcast_convert_type(
+                        seg.reshape(d, count, itemsize),
+                        jnp.dtype(leaf_spec.dtype))
+                arr = flat.reshape((d,) + leaf_spec.chunk_shape)
+                # Undo the host-side moveaxis, then merge (shards, b)
+                # back into the batch axis — with the input sharded over
+                # its leading axis and the output constrained to the
+                # leaf's batch sharding this stays a local relabeling.
+                arr = jnp.moveaxis(arr, 0, leaf_spec.batch_axis)
+                arr = arr.reshape(leaf_spec.shape)
+                leaves.append(
+                    jax.lax.with_sharding_constraint(arr, sharding))
+            return _tree_unflatten(spec.treedef, leaves)
+
+        return jax.jit(unpack)
+
+    # -- the three stages (separable so bench_transport can decompose) -----
+
+    def pack(self, trajectory) -> np.ndarray:
+        """Trajectory -> this process's staging buffer (rotating between
+        two buffers so the previous upload may still be reading the
+        other one)."""
+        import jax
+
+        spec = self._ensure_spec(trajectory)
+        with self._lock:
+            slot = self._slot
+            self._slot = 1 - slot
+            if self._staging[slot] is None:
+                self._staging[slot] = np.zeros(
+                    (self._local_shards, spec.shard_nbytes), np.uint8)
+            buf = self._staging[slot]
+            pending = self._upload_done[slot]
+        if pending is not None:
+            # The slot's previous upload may still be streaming this
+            # host buffer to the device — overwriting it mid-transfer
+            # would silently corrupt that batch.  Two buffers deep this
+            # waits on upload k-1, which the intervening update has
+            # almost always outlived.
+            jax.block_until_ready(pending)
+        spec.pack_into(buf, trajectory)
+        return buf
+
+    def upload(self, buf: np.ndarray):
+        """ONE H2D copy: the packed buffer, sharded over its row axis."""
+        import jax
+
+        self._bytes_counter.inc(buf.nbytes)
+        if jax.process_count() > 1:
+            placed = jax.make_array_from_process_local_data(
+                self._buf_sharding, buf)
+        else:
+            placed = jax.device_put(buf, self._buf_sharding)
+        with self._lock:
+            # Remember which upload last read each staging buffer so the
+            # next pack into that slot can wait for it (see pack()).
+            for slot, staged in enumerate(self._staging):
+                if staged is buf:
+                    self._upload_done[slot] = placed
+        return placed
+
+    def unpack(self, device_buf):
+        """Jitted bitcast+slice+reshape back to the Trajectory pytree."""
+        return self._unpack_jit(device_buf)
+
+    # -- public API --------------------------------------------------------
+
+    def put(self, trajectory):
+        import jax
+
+        leaves = _tree_leaves(trajectory)
+        if any(isinstance(leaf, jax.Array) for leaf in leaves):
+            # Already on device (accum paths): re-shard, don't fetch.
+            return self._per_leaf.put(trajectory)
+        tracer = get_tracer()
+        with tracer.span("transport/pack", cat="h2d"), \
+                self._h_pack.time():
+            buf = self.pack(trajectory)
+        with tracer.span("transport/upload", cat="h2d",
+                         args={"bytes": int(buf.nbytes)}), \
+                self._h_upload.time():
+            device_buf = self.upload(buf)
+        with tracer.span("transport/unpack", cat="h2d"), \
+                self._h_unpack.time():
+            return self.unpack(device_buf)
+
+
+def make_transport(name: str, mesh, shardings_prefix, batch_axes_prefix):
+    """Config string -> transport.  ``per_leaf`` is the seed path;
+    ``packed`` is the single-copy pipeline."""
+    if name == "per_leaf":
+        return PerLeafTransport(mesh, shardings_prefix)
+    if name == "packed":
+        return PackedTransport(mesh, shardings_prefix, batch_axes_prefix)
+    raise ValueError(
+        f"unknown transport {name!r} (per_leaf | packed)")
+
+
+# ---------------------------------------------------------------------------
+# Bounded in-flight update window.
+# ---------------------------------------------------------------------------
+
+
+class InflightWindow:
+    """At most W dispatched-but-unmaterialized updates.
+
+    The driver pushes each update's metrics right after dispatch; once
+    ``depth`` reaches the window it retires the oldest — blocking until
+    that update's outputs exist — so the loop runs W-deep pipelined with
+    hard backpressure, and every retired metrics dict belongs to a known
+    update (FIFO: metrics are observed in dispatch order, so per-update
+    ``env_frames`` accounting stays exact).  W=1 is lock-step.
+    """
+
+    def __init__(self, window: int, registry=None):
+        import weakref
+
+        if window < 1:
+            raise ValueError(f"inflight window must be >= 1, got {window}")
+        self.window = int(window)
+        self._pending = deque()
+        registry = registry or get_registry()
+        pending_ref = weakref.ref(self._pending)
+        registry.gauge(
+            "learner/inflight_depth",
+            "dispatched updates whose outputs are not yet materialized",
+            fn=lambda: (len(p) if (p := pending_ref()) is not None
+                        else 0.0))
+        self._h_retire = registry.histogram(
+            "learner/retire_s",
+            "seconds blocked materializing the oldest in-flight update")
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    @property
+    def full(self) -> bool:
+        return len(self._pending) >= self.window
+
+    def push(self, metrics) -> None:
+        self._pending.append(metrics)
+
+    def retire(self):
+        """Block until the OLDEST in-flight update's outputs exist and
+        return its metrics (device arrays, ready to fetch for free)."""
+        import jax
+
+        metrics = self._pending.popleft()
+        with get_tracer().span("learner/retire", cat="learner"), \
+                self._h_retire.time():
+            jax.block_until_ready(metrics)
+        return metrics
+
+    def drain(self):
+        """Retire everything; returns the NEWEST metrics (or None when
+        nothing was in flight) — the loop-exit value the driver returns."""
+        metrics = None
+        while self._pending:
+            metrics = self.retire()
+        return metrics

@@ -222,3 +222,84 @@ class TestRegressionGuard:
         result = {"value": 1.0}
         bench.regression_guard(result, diag)
         assert diag["errors"] == []
+
+
+class TestTransportRegressionGuard:
+    """ISSUE 3 satellite: packed-vs-per-leaf and overlap invariants
+    (hermetic — no bench stage runs; diag dicts are synthesized)."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_packed_slower_than_per_leaf_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "transport_packed_speedup": 0.8,
+                "transport_packed_put_ms": 50.0,
+                "transport_per_leaf_put_ms": 40.0,
+                "transport_overlap_frac": 0.9}
+        bench.transport_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert any("TRANSPORT REGRESSION" in e and "SLOWER" in e
+                   for e in diag["errors"])
+        assert not any("overlap" in e for e in diag["errors"])
+
+    def test_low_overlap_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "transport_packed_speedup": 2.5,
+                "transport_overlap_frac": 0.3}
+        bench.transport_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert any("overlap fraction" in e for e in diag["errors"])
+
+    def test_healthy_run_is_silent(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "transport_packed_speedup": 2.1,
+                "transport_overlap_frac": 0.8}
+        bench.transport_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_cpu_fallback_warns_instead_of_failing(self, tmp_path):
+        """On a CPU fallback both numbers measure host memcpy weather,
+        not the framework — same comparability reasoning as the other
+        guards' platform gates, but the values still surface."""
+        diag = {"errors": [], "platform": "cpu",
+                "transport_packed_speedup": 0.7,
+                "transport_overlap_frac": 0.2}
+        bench.transport_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == []
+        assert len(diag["warnings"]) == 2
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     transport_packed_speedup=2.0,
+                                     transport_overlap_frac=0.9)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.transport_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "missing this round" in e]
+        assert len(missing) == 2
+
+    def test_silent_when_stage_never_ran_anywhere(self, tmp_path):
+        """No keys this round and none in the previous artifact: the
+        stage predates both rounds — nothing to guard."""
+        diag = {"errors": [], "platform": "tpu"}
+        bench.transport_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_runs_against_real_committed_artifacts(self):
+        """Against the repo's own BENCH_r*.json: rounds predating the
+        transport keys must compare nothing and never crash."""
+        diag = {"errors": [], "platform": "tpu",
+                "transport_packed_speedup": 2.0,
+                "transport_overlap_frac": 0.9}
+        bench.transport_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "TRANSPORT REGRESSION" in e]

@@ -80,9 +80,46 @@ def test_device_env_mirrors_host_stack(repeats, jitter, reward_mode):
 
 
 def test_device_env_rejects_overflow_seeds():
+    # Length jitter still multiplies the raw seed (host bigints vs
+    # device int32), so jittered envs keep the tight seed bound.
     dev = DeviceFakeEnv(height=H, width=W, length_jitter=2)
     with pytest.raises(ValueError, match="seeds must stay below"):
         dev.initial(np.asarray([10**7], np.int32))
+
+
+@pytest.mark.parametrize("reward_mode", ["schedule", "bandit", "memory"])
+def test_device_env_mirrors_host_at_large_seed(reward_mode):
+    """ADVICE r5: ``(seed * 131) % a`` overflowed int32 above seed
+    ~16.4M, so device and host cues (and schedule-mode frames) silently
+    disagreed.  The mod-before-multiply fix must be exact at seeds far
+    beyond that bound."""
+    seeds = [100_000_000, 2**31 - 1]
+    episode_length = 4
+    dev = DeviceFakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
+                        episode_length=episode_length,
+                        reward_mode=reward_mode)
+    streams = host_streams(seeds, episode_length, jitter=0, repeats=1,
+                           reward_mode=reward_mode)
+    state, out = dev.initial(np.asarray(seeds, np.int32))
+    host_outs = [s.initial() for s in streams]
+    step = jax.jit(dev.step)
+
+    rng = np.random.default_rng(1)
+    for t in range(10):
+        for i, h in enumerate(host_outs):
+            np.testing.assert_array_equal(
+                np.asarray(out.observation.frame[i]),
+                np.asarray(h.observation.frame),
+                err_msg=f"frame mismatch seed {seeds[i]} step {t}")
+            np.testing.assert_allclose(
+                float(out.reward[i]), float(h.reward), rtol=1e-6,
+                err_msg=f"reward mismatch seed {seeds[i]} step {t}")
+            assert bool(out.done[i]) == bool(h.done), (i, t)
+        actions = rng.integers(0, NUM_ACTIONS, size=len(seeds))
+        state, out = step(state, jnp.asarray(actions, jnp.int32))
+        host_outs = [s.step(int(a)) for s, a in zip(streams, actions)]
+    for s in streams:
+        s.close()
 
 
 class TestInGraphTrainer:

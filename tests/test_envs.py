@@ -263,3 +263,51 @@ class TestWrappers:
             (tmp_path / "episode_00000" / "episode.json").read_text())
         assert meta["actions"] == [1, 0]
         assert len(meta["rewards"]) == 2
+
+    def test_recording_respawn_does_not_overwrite(self, tmp_path):
+        """ADVICE r5 regression: a respawned worker re-runs the
+        constructor on the same directory; its first recorded episode
+        used to reuse (and overwrite) the previous instance's last
+        episode number because the advance was gated on the episode
+        counter instead of on whether THIS instance had reset."""
+        import json
+
+        env = W.RecordingWrapper(small_env(episode_length=2),
+                                 str(tmp_path))
+        env.reset()
+        env.step(1)
+        env.step(1)
+        env.close()  # worker dies mid-run: episode_00000 on disk
+        first = json.loads(
+            (tmp_path / "episode_00000" / "episode.json").read_text())
+        assert first["actions"] == [1, 1]
+
+        respawn = W.RecordingWrapper(small_env(episode_length=2),
+                                     str(tmp_path))
+        respawn.reset()
+        respawn.step(0)
+        respawn.step(0)
+        respawn.close()
+        # The respawned worker numbered PAST the existing recording...
+        second = json.loads(
+            (tmp_path / "episode_00001" / "episode.json").read_text())
+        assert second["actions"] == [0, 0]
+        # ...and the original episode is untouched.
+        preserved = json.loads(
+            (tmp_path / "episode_00000" / "episode.json").read_text())
+        assert preserved["actions"] == [1, 1]
+
+    def test_recording_stepless_reset_still_reuses_number(self,
+                                                          tmp_path):
+        """The respawn fix must not regress the stepless-reset rule: a
+        reset-reset pair with no steps between keeps recordings
+        consecutive from episode_00000."""
+        env = W.RecordingWrapper(small_env(episode_length=2),
+                                 str(tmp_path))
+        env.reset()
+        env.reset()  # stepless: reuses episode 0, flushes nothing
+        env.step(1)
+        env.step(0)
+        env.close()
+        assert (tmp_path / "episode_00000" / "frames.npy").exists()
+        assert not (tmp_path / "episode_00001").exists()

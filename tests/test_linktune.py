@@ -176,3 +176,46 @@ class TestResolve:
         link = probe_link(upload_bytes=1 << 20)
         assert 0.0 < link.rtt_s < 5.0
         assert link.h2d_bytes_per_s > 1e5
+
+
+class TestBandwidthClamp:
+    """RTT jitter must not let the probe report impossible bandwidth
+    (ADVICE r5): ``upload_s - rtt_s`` hitting the 1e-9 floor used to
+    yield ~8e15 B/s, falsely clearing bench.py's 300 MB/s e2e retry
+    gate."""
+
+    def test_jitter_inflated_rtt_is_clamped(self):
+        from scalable_agent_tpu.runtime.linktune import (
+            MAX_H2D_BYTES_PER_S,
+            MIN_TRANSFER_FRAC,
+            _clamped_bandwidth,
+        )
+
+        # A jitter spike made the RTT probes read LONGER than the whole
+        # upload window: the naive subtraction would divide by 1e-9.
+        bw = _clamped_bandwidth(16 << 20, upload_s=0.060, rtt_s=0.067)
+        assert bw <= MAX_H2D_BYTES_PER_S
+        # The transfer window floors at MIN_TRANSFER_FRAC of the upload
+        # window, so the report is bounded by 1/frac x bytes/window.
+        assert bw == pytest.approx(
+            (16 << 20) / (MIN_TRANSFER_FRAC * 0.060))
+        assert bw < 8e15  # the r5 artifact this guards against
+
+    def test_clean_measurement_unchanged(self):
+        from scalable_agent_tpu.runtime.linktune import _clamped_bandwidth
+
+        # Healthy window: RTT well below the upload time — the clamp
+        # must not perturb the honest estimate.
+        bw = _clamped_bandwidth(16 << 20, upload_s=0.200, rtt_s=0.010)
+        assert bw == pytest.approx((16 << 20) / 0.190)
+
+    def test_absolute_cap(self):
+        from scalable_agent_tpu.runtime.linktune import (
+            MAX_H2D_BYTES_PER_S,
+            _clamped_bandwidth,
+        )
+
+        # Even a plausible-looking subtraction cannot report above the
+        # physical cap.
+        bw = _clamped_bandwidth(1 << 30, upload_s=0.0101, rtt_s=0.010)
+        assert bw == MAX_H2D_BYTES_PER_S
