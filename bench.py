@@ -294,7 +294,8 @@ def _timed_updates(update, state, traj, iters):
     return (time.perf_counter() - t0) / iters, state, metrics
 
 
-def _bench_learner_setup(batch, compile_diag, transport="per_leaf"):
+def _bench_learner_setup(batch, compile_diag, transport="per_leaf",
+                         finite_guard=True):
     """Shared construction for the learner stages (B=32 headline, B=256
     diagnostic, and the transport stage — ONE code path so sync/compile/
     shape fixes can't drift apart): agent/mesh/learner/example
@@ -319,7 +320,7 @@ def _bench_learner_setup(batch, compile_diag, transport="per_leaf"):
     mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
     learner = Learner(agent, LearnerHyperparams(), mesh,
                       frames_per_update=frames_per_update,
-                      transport=transport)
+                      transport=transport, finite_guard=finite_guard)
     traj_host = _example_trajectory(
         unroll_len, batch, height, width, num_actions)
     state = learner.init(jax.random.key(0), traj_host)
@@ -1264,6 +1265,106 @@ def bench_transport(diag, budget_s=150.0):
 TRANSPORT_GUARD_MIN_OVERLAP = 0.5
 
 
+def bench_resilience(diag, budget_s=90.0):
+    """Resilience-layer stage (ISSUE 4): the non-finite guard fused into
+    the jitted update (runtime/learner.py) must cost <1% of the update
+    stage, and a skipped (all-NaN) update must retire at the same rate
+    as a normal one — the guard's whole point is that a NaN storm costs
+    throughput, not correctness.  Times the shipping guarded update
+    against a guard-free learner at production shapes (same
+    ``_bench_learner_setup`` path as the headline stage; CPU fallback
+    shrinks the batch so two compiles fit the budget), minima over two
+    runs each so scheduler jitter biases both numbers the same way."""
+    import numpy as np
+
+    t_start = time.perf_counter()
+    cpu = diag.get("platform") == "cpu"
+    batch = 8 if cpu else 32
+    diag["resilience_batch"] = batch
+    sub = {"errors": diag["errors"]}
+
+    # Build and WARM both programs before timing either: the first
+    # minutes of a fresh backend (allocator growth, code cache) are
+    # systematically slower, and measuring guarded-then-plain in that
+    # window reads the warmup as "guard overhead".  Interleaved timed
+    # runs + minima cancel what remains.
+    learner_g, update_g, state_g, traj_g, traj_host, _ = (
+        _bench_learner_setup(batch, sub, finite_guard=True))
+    learner_p, update_p, state_p, traj_p, _, _ = (
+        _bench_learner_setup(batch, {"errors": []}, finite_guard=False))
+    once, state_g, _ = _timed_updates(update_g, state_g, traj_g, 1)
+    _, state_p, _ = _timed_updates(update_p, state_p, traj_p, 1)
+    per_run_s = min(budget_s / 8.0, 10.0)
+    iters = max(3, min(100, int(per_run_s / max(once, 1e-4))))
+    diag["resilience_iters"] = iters
+    dts_g, dts_p = [], []
+    for _ in range(3):
+        dt, state_g, _ = _timed_updates(update_g, state_g, traj_g, iters)
+        dts_g.append(dt)
+        dt, state_p, _ = _timed_updates(update_p, state_p, traj_p, iters)
+        dts_p.append(dt)
+    dt_guarded, dt_plain = min(dts_g), min(dts_p)
+    del learner_p, update_p, state_p, traj_p
+
+    # The skip path: poison the rewards so EVERY iteration takes the
+    # params-held branch — same program, the selects just keep the old
+    # operand, so this should time within noise of the normal path.
+    bad_host = traj_host._replace(
+        env_outputs=traj_host.env_outputs._replace(
+            reward=np.asarray(traj_host.env_outputs.reward)
+            * np.float32("nan")))
+    traj_bad = learner_g.put_trajectory(bad_host)
+    dt_skip, state_g, skip_metrics = _timed_updates(
+        update_g, state_g, traj_bad, iters)
+    if _fetch_scalar(skip_metrics["update_skipped"]) != 1.0:
+        diag["errors"].append(
+            "bench_resilience: NaN-poisoned batch was NOT skipped — "
+            "the non-finite guard is not engaging")
+    diag["resilience_skip_sec_per_update"] = round(dt_skip, 6)
+    diag["resilience_skip_vs_normal"] = round(dt_skip / dt_guarded, 3)
+    del learner_g, update_g, state_g, traj_g, traj_bad
+
+    diag["resilience_guarded_sec_per_update"] = round(dt_guarded, 6)
+    diag["resilience_plain_sec_per_update"] = round(dt_plain, 6)
+    diag["resilience_finite_check_frac"] = round(
+        (dt_guarded - dt_plain) / dt_plain, 5)
+    diag["resilience_secs"] = round(time.perf_counter() - t_start, 1)
+
+
+# The finite check's budget on the update stage (ISSUE 4 acceptance).
+RESILIENCE_BUDGET_FRAC = 0.01
+
+
+def resilience_regression_guard(diag):
+    """ISSUE 4 acceptance: fail the bench when the fused finite check
+    costs more than 1% of the update stage (same wiring pattern as
+    obs_regression_guard).  On the CPU fallback two independently
+    compiled programs can differ by more than 1% from XLA scheduling
+    alone, so the breach is advisory there — the TPU number is the
+    binding one."""
+    frac = diag.get("resilience_finite_check_frac")
+    if frac is None:
+        return  # stage never ran (its own error already recorded)
+    if frac > RESILIENCE_BUDGET_FRAC:
+        msg = (
+            f"RESILIENCE: finite-check overhead {frac:.2%} of the "
+            f"update stage exceeds the {RESILIENCE_BUDGET_FRAC:.0%} "
+            f"budget (guarded "
+            f"{diag.get('resilience_guarded_sec_per_update')}s vs plain "
+            f"{diag.get('resilience_plain_sec_per_update')}s)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory, host-compile jitter "
+                "exceeds the budget's resolution")
+        else:
+            diag["errors"].append(msg)
+    ratio = diag.get("resilience_skip_vs_normal")
+    if ratio is not None and ratio > 1.5:
+        diag.setdefault("warnings", []).append(
+            f"resilience: a skipped update runs {ratio}x a normal one "
+            f"(expected ~1x — the guard's selects should be free)")
+
+
 def transport_regression_guard(diag, bench_dir=None):
     """ISSUE 3 satellite: the packed transport must stay strictly
     better than the per-leaf path, and the in-flight window must keep
@@ -1683,6 +1784,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_transport failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_resilience"
+    try:
+        bench_resilience(
+            diag, budget_s=90.0 if diag["platform"] != "cpu" else 45.0)
+    except Exception:
+        diag["errors"].append(
+            "bench_resilience failed: " + traceback.format_exc(limit=3))
     diag["stage"] = "e2e_link_retry"
     try:
         maybe_retry_e2e(diag, start_monotonic, deadline)
@@ -1708,6 +1816,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "transport regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "resilience_regression_guard"
+    try:
+        resilience_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "resilience regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()

@@ -174,6 +174,29 @@ class Config:
     # <logdir>/metrics.prom off disk.  Multi-process runs offset the
     # port by the process index.
     metrics_http_port: int = 0
+    # -- self-healing (docs/robustness.md) --------------------------------
+    # Non-finite guard: a NaN/Inf loss or gradient makes the update a
+    # no-op (params/opt_state held, frames still retired) and counts in
+    # learner/nonfinite_skips_total.  This many CONSECUTIVE skips
+    # triggers a rollback to the last verified checkpoint (or exit 71
+    # with --no_rollback).  0 disables the rollback policy; the guard
+    # itself is always on.
+    nonfinite_tolerance: int = 10
+    # Exit with code 71 instead of rolling back when the non-finite
+    # tolerance is exhausted — the right setting under a supervisor
+    # that reschedules the run (rollback-on-restart then happens via
+    # the normal resume path).
+    no_rollback: bool = False
+    # Bounded actor-thread respawn: a failing actor retries with capped
+    # exponential backoff this many times before its exception ends the
+    # run (actor/restarts_total; per-actor detail in the flight
+    # recorder).  0 restores fail-fast.
+    actor_max_restarts: int = 3
+    # Deterministic fault injection (runtime/faults.py), chaos testing
+    # only: 'point@i[:j...]' entries joined by ';', e.g.
+    # 'nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20'.
+    # Empty = no faults.
+    chaos_spec: str = ""
 
     # -------------------------------------------------------------------
 

@@ -25,6 +25,15 @@ Transport flags (docs/performance.md, "The trajectory transport"):
         the device.  2 (the default) pipelines one update deep with
         exact FIFO metrics accounting; 1 forces strict per-update
         lock-step (debugging, not throughput).
+
+Self-healing flags (docs/robustness.md):
+    --nonfinite_tolerance=N   consecutive non-finite (skipped) updates
+        before rolling back to the last verified checkpoint; with
+        --no_rollback the run exits 71 instead.
+    --actor_max_restarts=K    bounded actor-thread respawn budget with
+        capped exponential backoff.
+    --chaos_spec='point@i[:j...];...'   deterministic fault injection
+        (runtime/faults.py) for chaos testing the recovery paths.
 """
 
 import argparse
@@ -71,8 +80,10 @@ from scalable_agent_tpu.runtime import (
     InflightWindow,
     Learner,
     LearnerHyperparams,
+    NonFiniteTracker,
     TrainState,
     Trajectory,
+    configure_faults,
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
 from scalable_agent_tpu.types import (
@@ -491,6 +502,78 @@ def _teardown_observability(config: Config, handles: _ObsHandles):
         handles.uninstall_handlers()
 
 
+# Exit code for a run ended by the non-finite guard (tolerance exhausted
+# with --no_rollback, or no checkpoint left to roll back to).  Distinct
+# from the watchdog's 70 so a supervisor can tell a numeric divergence
+# from a hang.
+NONFINITE_EXIT_CODE = 71
+
+
+def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
+                      learner: Learner, state: TrainState,
+                      tracker: NonFiniteTracker):
+    """The non-finite tolerance is exhausted: restore the newest
+    VERIFIED checkpoint (watchdog suspended across the read) and return
+    ``(state, updates, frames)`` on the rolled-back timeline — or raise
+    ``SystemExit(71)`` when rollback is disabled or impossible."""
+    recorder = get_flight_recorder()
+    registry = get_registry()
+    if config.no_rollback:
+        log.error(
+            "non-finite guard: %d consecutive skipped updates and "
+            "--no_rollback is set — exiting %d",
+            tracker.tolerance, NONFINITE_EXIT_CODE)
+        recorder.record("rollback", "disabled",
+                        {"streak": tracker.tolerance})
+        recorder.dump_all("nonfinite:no_rollback")
+        raise SystemExit(NONFINITE_EXIT_CODE)
+    watchdog = get_watchdog()
+    # A long Orbax read is recovery, not a wedge: the learner heartbeat
+    # must not trip stalled_thread (or --watchdog_abort) mid-restore.
+    watchdog.suspend("learner")
+    from scalable_agent_tpu.runtime.checkpoint import (
+        CheckpointIntegrityError,
+    )
+
+    try:
+        restored = ckpt.restore(target=state)
+    except CheckpointIntegrityError as exc:
+        # Checkpoints exist but none verified: with the tolerance
+        # already exhausted there is nothing to roll back to — same
+        # terminal outcome as having no checkpoint at all.
+        log.error("non-finite guard: %s", exc)
+        restored = None
+    if restored is None:
+        log.error(
+            "non-finite guard: tolerance exhausted and no restorable "
+            "checkpoint under %s — exiting %d", config.logdir,
+            NONFINITE_EXIT_CODE)
+        recorder.record("rollback", "no_checkpoint", {})
+        recorder.dump_all("nonfinite:no_checkpoint")
+        raise SystemExit(NONFINITE_EXIT_CODE)
+    step, host_state = restored
+    # Zero the streak so the restored timeline gets the full tolerance
+    # again (the checkpoint may have been saved mid-streak).
+    host_state = host_state._replace(
+        nonfinite_streak=np.zeros_like(
+            np.asarray(host_state.nonfinite_streak)))
+    state = learner.place_state(host_state)
+    registry.counter(
+        "learner/rollbacks_total",
+        "rollbacks to the last good checkpoint after the non-finite "
+        "tolerance was exhausted").inc()
+    frames = _host_scalar(state.env_frames)
+    recorder.record("rollback", "restored",
+                    {"step": step, "env_frames": frames})
+    tracker.rebase(_host_scalar(state.nonfinite_skips))
+    watchdog.touch("learner")
+    log.warning(
+        "non-finite guard: rolled back to checkpoint step %d "
+        "(%.0f frames) after %d consecutive skipped updates",
+        step, frames, tracker.tolerance)
+    return state, step, frames
+
+
 def train(config: Config) -> Dict[str, float]:
     """Train until total_environment_frames.  Returns final metrics.
 
@@ -522,6 +605,10 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     if is_coordinator():
         config.save()
+    # Chaos harness: arm the deterministic fault-injection points
+    # (no-op with an empty spec); disarmed again in the finally so one
+    # run's spec can't leak into the next in-process run.
+    configure_faults(config.chaos_spec)
     # Observability comes up BEFORE the actor pool so its threads are
     # born with the live tracer and watchdog (spans/heartbeats from the
     # very first unroll); the try below owns teardown from this point
@@ -567,7 +654,8 @@ def train(config: Config) -> Dict[str, float]:
                          level_name=config.level_name, seed=config.seed,
                          inference_mode=config.inference_mode,
                          observation_spec=observation_spec,
-                         fused_shards=config.accum_fused_shards)
+                         fused_shards=config.accum_fused_shards,
+                         max_restarts=config.actor_max_restarts)
         pool.set_params(state.params)
         pool.start()
 
@@ -579,6 +667,15 @@ def train(config: Config) -> Dict[str, float]:
                                          prefetch_stop)
 
         stall = StallAttributor(registry)
+        # Non-finite guard policy: the jitted update carries the skip
+        # counters in its metrics (runtime/learner.py); this tracker
+        # reads them at log time — the fetch the loop already pays —
+        # and arbitrates rollback vs exit 71.  Baseline at the restored
+        # state's cumulative count: a resumed run must not re-count the
+        # previous run's lifetime skips into this process's counter.
+        nonfinite = NonFiniteTracker(config.nonfinite_tolerance,
+                                     registry=registry)
+        nonfinite.rebase(_host_scalar(state.nonfinite_skips))
         actor_steps_counter = registry.counter("actor/agent_steps_total")
         actor_fps_gauge = registry.gauge(
             "actor/fps", "env frames/s generated by this host's actors")
@@ -621,6 +718,7 @@ def train(config: Config) -> Dict[str, float]:
         # backpressure and per-update metrics ordering stay exact.
         inflight = InflightWindow(config.inflight_updates,
                                   registry=registry)
+        rollback_wanted = False
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
                     and updates - start_updates
@@ -679,6 +777,13 @@ def train(config: Config) -> Dict[str, float]:
                     metrics = dispatched
                 host_metrics = {k: _host_scalar(v)
                                 for k, v in metrics.items()}
+                # Only RECORD the verdict here: the log gate runs on
+                # local wall clocks, and acting inside it would let
+                # multi-host processes enter the collective restore on
+                # different iterations.  The rollback itself happens at
+                # the fixed per-iteration point below.
+                if nonfinite.observe(host_metrics):
+                    rollback_wanted = True
                 fps = (frames - frames_at_last_log) / (now - last_log)
                 host_metrics["fps"] = fps
                 stats = pool.episode_stats()
@@ -757,6 +862,40 @@ def train(config: Config) -> Dict[str, float]:
                              for k, v in timing_summary.items()),
                     StallAttributor.describe(category, evidence))
                 last_log, frames_at_last_log = now, frames
+            # Rollback at a point EVERY process reaches on the SAME
+            # iteration, with the coordinator's verdict broadcast — the
+            # divergent-local-clocks discipline maybe_save applies to
+            # its save decision — so the collective restore inside
+            # _rollback_or_exit is entered by all processes together.
+            # The multi-host broadcast is gated on the update counter
+            # (identical on every process, unlike wall clocks) every 8
+            # updates, so the hot loop doesn't pay a second per-update
+            # collective; the added detection latency is dwarfed by the
+            # log-interval gate above, and skipped updates are no-ops
+            # anyway.
+            do_rollback = rollback_wanted
+            if jax.process_count() > 1:
+                do_rollback = False
+                if updates % 8 == 0:
+                    from jax.experimental import multihost_utils
+
+                    do_rollback = bool(
+                        multihost_utils.broadcast_one_to_all(
+                            np.asarray(rollback_wanted)))
+            if do_rollback:
+                rollback_wanted = False
+                state, updates, frames = _rollback_or_exit(
+                    config, ckpt, learner, state, nonfinite)
+                # Nothing from the abandoned timeline may leak forward:
+                # drop in-flight metrics (without blocking on them) and
+                # republish the restored weights.
+                inflight.discard()
+                metrics = {}
+                pool.set_params(state.params, version=updates)
+                last_log = time.monotonic()
+                frames_at_last_log = frames
+                interval.clear()
+                continue
             ckpt.maybe_save(updates, state)
         # Disarm before the shutdown tail (final forced checkpoint,
         # pool joins, writer close): a slow-but-healthy shutdown must
@@ -777,6 +916,7 @@ def train(config: Config) -> Dict[str, float]:
         # heartbeat that simply stopped because the run is ending.
         # (The exception dump in _teardown_observability still runs.)
         configure_watchdog(None)
+        configure_faults("")  # chaos spec must not outlive its run
         if profiling:
             jax.profiler.stop_trace()
         prefetch_stop.set()
@@ -876,6 +1016,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             "covers multi-host training)")
     config = apply_env_overrides(config)
     config.save()
+    configure_faults(config.chaos_spec)  # disarmed again in the finally
 
     # Probe the HOST twin of the level so action/observation specs stay
     # in lock-step with the device mirror (they are asserted
@@ -927,6 +1068,10 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     obs_handles = _setup_observability(config, coordinator=True)
     registry, prom = obs_handles.registry, obs_handles.prom
     watchdog = get_watchdog()
+    nonfinite = NonFiniteTracker(config.nonfinite_tolerance,
+                                 registry=registry)
+    # A resumed run must not re-count the checkpoint's lifetime skips.
+    nonfinite.rebase(_host_scalar(state.nonfinite_skips))
     try:
         # Context-managed writer: the JSONL handle can't leak when the
         # loop (or checkpointing) raises.
@@ -948,6 +1093,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                 if now - last_log >= config.log_interval_s:
                     host_metrics = _finalize_ingraph_metrics(
                         metrics, config)
+                    if nonfinite.observe(host_metrics):
+                        state, updates, frames = _rollback_or_exit(
+                            config, ckpt, learner, state, nonfinite)
+                        # The rollout carry is env-side state, not
+                        # params — it rides through the rollback like
+                        # the host backend's env processes do.
+                        last_log = time.monotonic()
+                        frames_at_last_log = frames
+                        continue
                     fps = (frames - frames_at_last_log) / (now - last_log)
                     host_metrics["fps"] = fps
                     timing_summary = timing.summary()
@@ -972,6 +1126,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             ckpt.maybe_save(updates, state, force=True)
     finally:
         configure_watchdog(None)  # same teardown-tail disarm as train()
+        configure_faults("")
         ckpt.close()
         _teardown_observability(config, obs_handles)
     return _finalize_ingraph_metrics(metrics, config)

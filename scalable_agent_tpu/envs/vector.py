@@ -333,6 +333,19 @@ class MultiEnv:
             "(%d in window, %d lifetime)",
             w, self._slices[w].start, self._slices[w].stop,
             len(times), self.total_respawns)
+        # Recovery-matrix visibility (docs/robustness.md): respawns get
+        # the same counter + flight-recorder treatment as every other
+        # self-healing path, so a chaos run's artifacts account for
+        # each injected worker_kill.
+        from scalable_agent_tpu.obs import get_flight_recorder, get_registry
+
+        get_registry().counter(
+            "env/worker_respawns_total",
+            "env worker processes respawned after dying").inc()
+        get_flight_recorder().record(
+            "worker_respawn", f"worker-{w}",
+            {"deaths_in_window": len(times),
+             "lifetime": self.total_respawns})
         try:
             self._conns[w].close()
         except OSError:
@@ -435,6 +448,32 @@ class MultiEnv:
             raise RuntimeError("step_recv without step_send")
         self._pending = False
         return self._gather()
+
+    def resync(self) -> None:
+        """Best-effort pipe re-alignment after an exception of unknown
+        provenance (the actor retry path): drain stale worker replies
+        so the next ``initial()``/``step_send`` doesn't read one as its
+        own.  Deliberately NOT gated on ``_pending`` — ``step_recv``
+        clears the flag BEFORE ``_gather``, so a failure mid-gather
+        (e.g. one worker's respawn budget raising after half the
+        replies were read) leaves undrained replies with ``_pending``
+        already False.  Each pipe is drained until it stays quiet for a
+        bounded window; errors are swallowed — if the envs are truly
+        broken, the retry's next step surfaces them against the
+        respawn budget."""
+        self._pending = False
+        for conn in self._conns:
+            if conn is None:
+                continue
+            try:
+                # 1s quiet period: long enough for a genuinely
+                # in-flight step reply to land (so it can't arrive
+                # AFTER the drain and desync the next unroll), bounded
+                # so a dead pipe costs the retry path one second.
+                while conn.poll(1.0):
+                    conn.recv()
+            except (EOFError, OSError):
+                continue
 
     def step(self, actions) -> StepOutput:
         self.step_send(actions)

@@ -8,9 +8,16 @@ from scalable_agent_tpu.runtime.batcher import (
     BatcherClosedError,
     DynamicBatcher,
 )
+from scalable_agent_tpu.runtime.faults import (
+    FaultInjector,
+    InjectedFault,
+    configure_faults,
+    get_fault_injector,
+)
 from scalable_agent_tpu.runtime.learner import (
     Learner,
     LearnerHyperparams,
+    NonFiniteTracker,
     TrainState,
     Trajectory,
 )

@@ -354,6 +354,19 @@ class AccumVectorActor:
             agent_outputs=agent_bufs,
         )
 
+    def reset(self):
+        """Drop device buffers + host carry after a mid-unroll failure
+        (the ActorPool retry path, mirroring VectorActor.reset): the
+        donated step program may have consumed ``_bufs`` before the
+        exception, so the next unroll must re-bootstrap rather than
+        touch possibly-invalidated device memory."""
+        resync = getattr(self._envs, "resync", None)
+        if resync is not None:
+            resync()
+        self._bufs = None
+        self._core_state = None
+        self._last_env_host = None
+
     def close(self):
         self._envs.close()
 
@@ -485,6 +498,18 @@ class GroupedAccumActor:
                     take, agent_bufs, is_leaf=lambda x: x is None),
             ))
         return outputs
+
+    def reset(self):
+        """Mirror of AccumVectorActor.reset for the lockstep driver:
+        re-align every group's env pipes and force a re-bootstrap (the
+        vmapped step donates ``_bufs`` too)."""
+        for envs in self.envs_list:
+            resync = getattr(envs, "resync", None)
+            if resync is not None:
+                resync()
+        self._bufs = None
+        self._core = None
+        self._last_outs = None
 
     def close(self):
         for envs in self.envs_list:

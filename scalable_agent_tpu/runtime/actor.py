@@ -182,6 +182,18 @@ class VectorActor:
             agent_outputs=_stack_time(agent_entries),
         )
 
+    def reset(self):
+        """Drop the carried unroll state after a mid-unroll failure
+        (ActorPool's retry path): re-align the env pipes and force a
+        fresh bootstrap — the next unroll starts from clean initial
+        outputs instead of a half-stepped carry."""
+        resync = getattr(self._envs, "resync", None)
+        if resync is not None:
+            resync()
+        self._last_env_output = None
+        self._last_agent_output = None
+        self._core_state = None
+
     def close(self):
         self._envs.close()
 
@@ -216,6 +228,10 @@ class ActorPool:
         service_timeout_ms: float = 5.0,
         observation_spec=None,
         fused_shards: int = 0,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.5,
+        restart_backoff_cap_s: float = 30.0,
+        restart_window_s: float = 600.0,
     ):
         # Inference runs on ONE device (by default the first): actor
         # threads must never launch multi-device SPMD programs — concurrent
@@ -349,6 +365,18 @@ class ActorPool:
         self._stop = threading.Event()
         self._threads = []
         self._errors = []
+        # Bounded respawn budget per actor thread (--actor_max_restarts):
+        # a transient fault retries with capped exponential backoff; the
+        # terminal exception surfaces only once the budget is spent.
+        # The budget is WINDOWED (restarts within restart_window_s, the
+        # same crash-loop-not-lifetime-fault semantics as MultiEnv's
+        # worker respawn budget): isolated faults days apart must never
+        # accumulate into a kill.  0 restores the old fail-fast
+        # marshalling.
+        self._max_restarts = max(0, int(max_restarts))
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self._restart_window_s = float(restart_window_s)
 
         # Observability: trajectory-queue gauges sample by callback
         # (nothing on the hot path); the frames counter gives actor-side
@@ -380,6 +408,11 @@ class ActorPool:
             "= env frames)")
         self._trajectories_counter = registry.counter(
             "actor/trajectories_total", "unrolls handed to the queue")
+        self._restarts_counter = registry.counter(
+            "actor/restarts_total",
+            "actor-thread respawns after a transient failure (the "
+            "per-actor detail rides the flight recorder's "
+            "actor_restart events)")
         self._frames_per_trajectory = unroll_length * (
             env_groups[0].num_envs if env_groups else 0)
 
@@ -489,52 +522,140 @@ class ActorPool:
 
     # -- run ---------------------------------------------------------------
 
-    def _actor_loop(self, actor: VectorActor):
+    def _chaos_kill_worker(self, actor) -> None:
+        """``worker_kill`` injection: SIGKILL one env worker process of
+        this actor — MultiEnv's respawn machinery must absorb it."""
+        envs_list = (getattr(actor, "envs_list", None)
+                     or [getattr(actor, "_envs", None)])
+        for envs in envs_list:
+            procs = getattr(envs, "_procs", None)
+            if not procs:
+                continue
+            proc = procs[0]
+            if proc is not None and proc.is_alive():
+                from scalable_agent_tpu.utils import log
+
+                log.warning("chaos: killing env worker pid %d", proc.pid)
+                proc.kill()
+                return
+
+    def _unroll_loop(self, actor: VectorActor):
+        """The steady-state produce loop for one actor (runs until stop
+        or an exception; the retry layer in _actor_loop owns both)."""
+        from scalable_agent_tpu.runtime.faults import get_fault_injector
+
         recorder = get_flight_recorder()
+        while not self._stop.is_set():
+            # Re-read the global tracer each unroll: the driver may
+            # enable tracing after this thread was born.
+            tracer = get_tracer()
+            watchdog = get_watchdog()
+            watchdog.touch()
+            injector = get_fault_injector()
+            if injector.active:
+                injector.maybe_raise("actor_raise")
+                if injector.should_fire("worker_kill"):
+                    self._chaos_kill_worker(actor)
+            params = self._get_params()
+            with tracer.span("actor/unroll", cat="actor"):
+                result = actor.run_unroll(params)
+            # Grouped (co-dispatch) actors emit one trajectory per
+            # group per lockstep unroll.
+            items = result if isinstance(result, list) else [result]
+            recorder.record("unroll", actor.level_name or "actor",
+                            {"trajectories": len(items)})
+            for trajectory in items:
+                delivered = False
+                with tracer.span("batcher/queue_put", cat="queue"):
+                    while not self._stop.is_set():
+                        watchdog.touch()  # a full queue is not a wedge
+                        try:
+                            self.queue.put(trajectory, timeout=0.1)
+                            delivered = True
+                            break
+                        except queue_lib.Full:
+                            continue
+                if delivered:  # shutdown can abandon the put
+                    recorder.record("queue", "put")
+                    self._trajectories_counter.inc()
+                    self._frames_counter.inc(
+                        self._frames_per_trajectory)
+
+    def _actor_loop(self, actor: VectorActor):
+        """Retry shell around ``_unroll_loop``: a failing actor thread
+        gets ``max_restarts`` respawns within a sliding
+        ``restart_window_s`` (crash-loop detection — isolated faults
+        days apart age out) with capped exponential backoff before its
+        terminal exception is marshalled to the driver — a transient
+        simulator/link fault must not end a multi-day run
+        (docs/robustness.md)."""
+        from scalable_agent_tpu.utils import log
+
+        from collections import deque
+
+        recorder = get_flight_recorder()
+        thread_name = threading.current_thread().name
+        # Restart timestamps within the sliding window (the budget
+        # detects crash LOOPS; a fault that struck hours ago has aged
+        # out — same semantics as MultiEnv._respawn_worker).
+        restart_times = deque()
         try:
             while not self._stop.is_set():
-                # Re-read the global tracer each unroll: the driver may
-                # enable tracing after this thread was born.
-                tracer = get_tracer()
-                watchdog = get_watchdog()
-                watchdog.touch()
-                params = self._get_params()
-                with tracer.span("actor/unroll", cat="actor"):
-                    result = actor.run_unroll(params)
-                # Grouped (co-dispatch) actors emit one trajectory per
-                # group per lockstep unroll.
-                items = result if isinstance(result, list) else [result]
-                recorder.record("unroll", actor.level_name or "actor",
-                                {"trajectories": len(items)})
-                for trajectory in items:
-                    delivered = False
-                    with tracer.span("batcher/queue_put", cat="queue"):
-                        while not self._stop.is_set():
-                            watchdog.touch()  # a full queue is not a wedge
-                            try:
-                                self.queue.put(trajectory, timeout=0.1)
-                                delivered = True
-                                break
-                            except queue_lib.Full:
-                                continue
-                    if delivered:  # shutdown can abandon the put
-                        recorder.record("queue", "put")
-                        self._trajectories_counter.inc()
-                        self._frames_counter.inc(
-                            self._frames_per_trajectory)
-        except Exception as exc:  # surface in get_trajectory
-            if self._stop.is_set():
-                return  # shutdown cascade (e.g. batcher closed) — benign
-            # The queue hand-off delivers the exception to the driver;
-            # the flight-recorder dump preserves THIS thread's last
-            # moments (ring tail + every thread's stack) even if the
-            # driver never drains it.
-            recorder.record("exception", type(exc).__name__,
-                            {"where": threading.current_thread().name})
-            recorder.dump_all(f"exception:{type(exc).__name__}:"
-                              f"{threading.current_thread().name}")
-            self._errors.append(exc)
-            self.queue.put(exc)
+                try:
+                    self._unroll_loop(actor)
+                    return  # clean stop
+                except Exception as exc:
+                    if self._stop.is_set():
+                        return  # shutdown cascade (e.g. batcher closed)
+                    recorder.record("exception", type(exc).__name__,
+                                    {"where": thread_name})
+                    now = time.monotonic()
+                    while (restart_times and now - restart_times[0]
+                           > self._restart_window_s):
+                        restart_times.popleft()
+                    if len(restart_times) >= self._max_restarts:
+                        # Budget spent: surface the terminal failure.
+                        # The queue hand-off delivers the exception to
+                        # the driver; the flight-recorder dump preserves
+                        # THIS thread's last moments (ring tail + every
+                        # thread's stack) even if the driver never
+                        # drains it.
+                        recorder.dump_all(
+                            f"exception:{type(exc).__name__}:"
+                            f"{thread_name}")
+                        self._errors.append(exc)
+                        self.queue.put(exc)
+                        return
+                    restart_times.append(now)
+                    in_window = len(restart_times)
+                    backoff = min(
+                        self._restart_backoff_cap_s,
+                        self._restart_backoff_s * 2 ** (in_window - 1))
+                    self._restarts_counter.inc()
+                    recorder.record(
+                        "actor_restart", thread_name,
+                        {"restart": in_window,
+                         "max": self._max_restarts,
+                         "backoff_s": round(backoff, 3),
+                         "error": type(exc).__name__})
+                    log.error(
+                        "actor %s failed (%s: %s) — restart %d/%d in "
+                        "the %.0fs window, retrying in %.2fs",
+                        thread_name, type(exc).__name__, exc, in_window,
+                        self._max_restarts, self._restart_window_s,
+                        backoff)
+                    # Idle backoff is not a wedge; the next unroll's
+                    # touch re-arms the heartbeat.
+                    get_watchdog().suspend()
+                    reset = getattr(actor, "reset", None)
+                    if reset is not None:
+                        try:
+                            reset()
+                        except Exception:
+                            log.exception(
+                                "actor %s reset failed before retry",
+                                thread_name)
+                    self._stop.wait(backoff)
         finally:
             get_watchdog().suspend()
 

@@ -1,0 +1,154 @@
+"""Deterministic fault injection: prove the self-healing layer works.
+
+A recovery path that only fires on real production faults is a recovery
+path that has never been tested.  This module is the chaos harness the
+robustness layer (docs/robustness.md) is validated against: a seedable,
+deterministic registry of *named injection points* compiled into the
+runtime's failure-prone seams —
+
+- ``nan_grad``   (runtime/learner.py): poison one update's rewards with
+  NaN so the non-finite guard must skip it.
+- ``actor_raise`` (runtime/actor.py): raise ``InjectedFault`` from an
+  actor thread's unroll loop, exercising the bounded-respawn retry.
+- ``worker_kill`` (runtime/actor.py): SIGKILL one env worker process,
+  exercising MultiEnv's respawn (tests/test_fault_tolerance.py).
+- ``ckpt_torn``  (runtime/checkpoint.py): corrupt the just-written
+  checkpoint on disk — a crash-mid-save stand-in — exercising the
+  integrity manifest + walk-back restore.
+- ``ckpt_save_fail`` (runtime/checkpoint.py): raise inside a cadenced
+  save, exercising the log-and-continue degrade path.
+
+The ``--chaos_spec`` grammar is ``point@i[:j:k...]`` entries joined by
+``;``: each integer is a 1-based *occurrence index* of that injection
+point (its Nth evaluation fires).  Example::
+
+    --chaos_spec='nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20'
+
+fires a NaN gradient on the 7th update, raises from an actor unroll on
+its 3rd and 12th evaluations, tears the 1st checkpoint save, and kills
+an env worker at the 20th unroll.  Occurrence counting is per-point and
+process-global (thread-safe), so a given spec replays the same faults
+at the same points every run — the property the chaos soak test
+(tests/test_chaos.py) is built on.  With no spec configured the
+injector is inert: every hot-path call is one attribute check.
+
+Every fired fault is breadcrumbed in the flight recorder (kind
+``fault``) and counted in ``faults/injected_total`` so a chaos run's
+artifacts show exactly which faults the recovery metrics answered.
+"""
+
+import re
+import threading
+from typing import Dict, FrozenSet
+
+from scalable_agent_tpu.obs import get_flight_recorder, get_registry
+
+__all__ = [
+    "FaultInjector",
+    "InjectedFault",
+    "configure_faults",
+    "get_fault_injector",
+    "parse_chaos_spec",
+]
+
+_ENTRY_RE = re.compile(r"([A-Za-z_][\w.]*)@(\d+(?::\d+)*)\Z")
+
+
+class InjectedFault(RuntimeError):
+    """An intentionally injected fault (chaos testing only).
+
+    Recovery code must treat it like any other transient failure — the
+    whole point is that the generic paths, not a special case, absorb
+    it."""
+
+
+def parse_chaos_spec(spec: str) -> Dict[str, FrozenSet[int]]:
+    """``'nan_grad@7;actor_raise@3:12'`` -> {point: {occurrences}}.
+
+    Raises ``ValueError`` (with the grammar) on malformed entries —
+    a silently-ignored typo would make a chaos run vacuously green.
+    """
+    points: Dict[str, FrozenSet[int]] = {}
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        match = _ENTRY_RE.match(entry)
+        if match is None:
+            raise ValueError(
+                f"malformed chaos_spec entry {entry!r}: expected "
+                f"'point@i[:j...]' with 1-based occurrence indices, "
+                f"e.g. 'nan_grad@7;actor_raise@3:12;ckpt_torn@1'")
+        name, occurrences = match.group(1), {
+            int(x) for x in match.group(2).split(":")}
+        if 0 in occurrences:
+            raise ValueError(
+                f"chaos_spec entry {entry!r}: occurrence indices are "
+                f"1-based")
+        points[name] = frozenset(occurrences) | points.get(
+            name, frozenset())
+    return points
+
+
+class FaultInjector:
+    """Occurrence-counting injection registry.  Deterministic: the Nth
+    evaluation of a point fires iff N is in the spec's list for it."""
+
+    def __init__(self, spec: str = ""):
+        self._points = parse_chaos_spec(spec)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        """False for the inert injector — hot paths gate on this so an
+        unconfigured run pays one attribute read per injection point."""
+        return bool(self._points)
+
+    def should_fire(self, point: str) -> bool:
+        """Count one evaluation of ``point``; True when this occurrence
+        is armed in the spec."""
+        if not self._points:
+            return False
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+        if n not in self._points.get(point, ()):
+            return False
+        get_flight_recorder().record("fault", point, {"occurrence": n})
+        get_registry().counter(
+            "faults/injected_total",
+            "faults fired by the chaos injection registry").inc()
+        return True
+
+    def maybe_raise(self, point: str):
+        """Raise ``InjectedFault`` when this occurrence of ``point`` is
+        armed; otherwise just count it."""
+        if self.should_fire(point):
+            raise InjectedFault(
+                f"injected fault at {point!r} "
+                f"(occurrence {self._counts[point]})")
+
+    def counts(self) -> Dict[str, int]:
+        """Evaluations seen per point (tests/diagnostics)."""
+        with self._lock:
+            return dict(self._counts)
+
+
+_DISABLED = FaultInjector("")
+_injector = _DISABLED
+_injector_lock = threading.Lock()
+
+
+def get_fault_injector() -> FaultInjector:
+    return _injector
+
+
+def configure_faults(spec: str = "") -> FaultInjector:
+    """Install (and return) the process-global injector.  Empty spec
+    restores the inert injector — the driver calls that in teardown so
+    one chaos run can't leak faults into the next."""
+    global _injector
+    with _injector_lock:
+        _injector = FaultInjector(spec) if spec else _DISABLED
+        return _injector

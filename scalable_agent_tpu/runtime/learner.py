@@ -44,6 +44,7 @@ from scalable_agent_tpu.parallel.mesh import (
     model_parallel_shardings,
     replicated_sharding,
 )
+from scalable_agent_tpu.runtime.faults import get_fault_injector
 from scalable_agent_tpu.runtime.transport import (
     broadcast_prefix,
     make_transport,
@@ -87,6 +88,14 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     env_frames: jax.Array  # f32 scalar, counts frames in exact multiples
+    # Non-finite-guard state (docs/robustness.md): cumulative skipped
+    # updates and the current consecutive-skip streak, carried ON DEVICE
+    # so the verdict rides whatever metrics fetch the driver already
+    # pays — no extra host sync per update.  f32 scalars (exact to 2^24
+    # counts); they ride the checkpoint like env_frames so a resumed
+    # run keeps its skip accounting.
+    nonfinite_skips: jax.Array
+    nonfinite_streak: jax.Array
 
 
 # Per-field batch-axis positions: agent_state leaves are [B, ...], the
@@ -139,11 +148,17 @@ class Learner:
         frames_per_update: int,
         scan_impl: str = "auto",
         transport: str = "per_leaf",
+        finite_guard: bool = True,
     ):
         self._agent = agent
         self._hp = hp
         self._mesh = mesh
         self._frames_per_update = float(frames_per_update)
+        # The non-finite guard is fused into the jitted update (a
+        # tree-wide isfinite reduction + per-leaf selects); ``False``
+        # exists for bench_resilience's baseline measurement, not for
+        # production runs.
+        self._finite_guard = bool(finite_guard)
         if scan_impl == "auto":
             # The associative scan is the auto choice everywhere: at
             # production shapes V-trace is ~2-5 us on-chip either way
@@ -232,6 +247,8 @@ class Learner:
             params=params,
             opt_state=opt_state,
             env_frames=jnp.float32(env_frames),
+            nonfinite_skips=jnp.float32(0.0),
+            nonfinite_streak=jnp.float32(0.0),
         )
         return self.place_state(state)
 
@@ -244,6 +261,8 @@ class Learner:
             opt_state=model_parallel_shardings(
                 self._mesh, state.opt_state),
             env_frames=self._replicated,
+            nonfinite_skips=self._replicated,
+            nonfinite_streak=self._replicated,
         )
 
     def place_state(self, state: TrainState) -> TrainState:
@@ -342,21 +361,62 @@ class Learner:
         updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
         params = optax.apply_updates(state.params, updates)
 
+        metrics = dict(metrics)
+        metrics["learning_rate"] = lr
+        metrics["grad_norm"] = optax.global_norm(grads)
+
+        skips, streak = state.nonfinite_skips, state.nonfinite_streak
+        if self._finite_guard:
+            # All-finite verdict over loss + every gradient leaf, fused
+            # into the update program (no host sync; the select below
+            # makes a non-finite step a no-op on params/opt_state while
+            # env_frames still advances — the batch WAS consumed, and
+            # the driver's host-side frame accounting increments
+            # unconditionally, so the two counts stay exact).
+            finite = jnp.isfinite(metrics["total_loss"])
+            for leaf in jax.tree_util.tree_leaves(grads):
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(leaf)))
+
+            def keep(new, old):
+                return jnp.where(finite, new, old)
+
+            params = jax.tree_util.tree_map(keep, params, state.params)
+            opt_state = jax.tree_util.tree_map(
+                keep, opt_state, state.opt_state)
+            skipped = 1.0 - finite.astype(jnp.float32)
+            skips = skips + skipped
+            streak = jnp.where(finite, 0.0, streak + 1.0)
+            # The verdict rides the existing metrics dict: cumulative +
+            # streak counters mean NO skip is lost even when the driver
+            # only materializes metrics every few updates (in-flight
+            # window) and only fetches them at log time.
+            metrics["update_skipped"] = skipped
+            metrics["nonfinite_skips"] = skips
+            metrics["nonfinite_streak"] = streak
+
         new_state = TrainState(
             params=params,
             opt_state=opt_state,
             env_frames=frames + self._frames_per_update,
+            nonfinite_skips=skips,
+            nonfinite_streak=streak,
         )
-        metrics = dict(metrics)
-        metrics["learning_rate"] = lr
         metrics["env_frames"] = new_state.env_frames
-        metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
     def update(self, state: TrainState, trajectory: Trajectory
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         """One training step.  ``trajectory`` should already be on device
         (``put_trajectory``) for best overlap; host batches also work."""
+        injector = get_fault_injector()
+        if injector.active and injector.should_fire("nan_grad"):
+            # Chaos: poison this batch's rewards so the loss (and every
+            # gradient) goes NaN — the guard must absorb it as a skip.
+            trajectory = trajectory._replace(
+                env_outputs=trajectory.env_outputs._replace(
+                    reward=trajectory.env_outputs.reward
+                    * jnp.float32(float("nan"))))
         with get_tracer().span("learner/update", cat="learner"):
             out = self._update(state, trajectory)
         self._updates_counter.inc()
@@ -366,3 +426,48 @@ class Learner:
         get_flight_recorder().record(
             "update", "learner", {"update": int(self._updates_counter.value)})
         return out
+
+
+class NonFiniteTracker:
+    """Host-side observer for the fused non-finite guard.
+
+    The jitted update carries cumulative/consecutive skip counters in
+    TrainState and mirrors them into its metrics dict; this tracker
+    reads them whenever the driver fetches metrics anyway (log time),
+    keeps the process-wide ``learner/nonfinite_skips_total`` counter and
+    flight-recorder breadcrumbs in step, and answers the one policy
+    question: has the consecutive-skip streak exhausted
+    ``--nonfinite_tolerance``?  (``tolerance=0`` disables the policy;
+    skips are still counted.)
+    """
+
+    def __init__(self, tolerance: int, registry=None):
+        from scalable_agent_tpu.obs import get_registry as _get_registry
+
+        self.tolerance = int(tolerance)
+        registry = registry or _get_registry()
+        self._counter = registry.counter(
+            "learner/nonfinite_skips_total",
+            "updates skipped by the non-finite guard (params/opt_state "
+            "held, env frames still retired)")
+        self._last_total = 0.0
+
+    def observe(self, host_metrics: Dict[str, float]) -> bool:
+        """Fold one fetched metrics dict in; True when the consecutive
+        streak has reached the tolerance (caller rolls back / exits)."""
+        total = float(host_metrics.get("nonfinite_skips", 0.0))
+        streak = float(host_metrics.get("nonfinite_streak", 0.0))
+        delta = total - self._last_total
+        if delta > 0:
+            self._counter.inc(delta)
+            get_flight_recorder().record(
+                "nonfinite_skip", "learner",
+                {"skips_total": total, "streak": streak})
+        self._last_total = max(self._last_total, total)
+        return bool(self.tolerance > 0 and streak >= self.tolerance)
+
+    def rebase(self, total: float):
+        """Re-anchor after a rollback: the restored state's cumulative
+        counter is older than what we already counted — without this,
+        the next observe() would double-count the gap."""
+        self._last_total = float(total)

@@ -603,3 +603,12 @@ class InflightWindow:
         while self._pending:
             metrics = self.retire()
         return metrics
+
+    def discard(self) -> int:
+        """Drop every in-flight metrics dict WITHOUT materializing it
+        (the rollback path: pending updates belong to the abandoned
+        timeline, blocking on them would only stretch the outage).
+        Returns how many were dropped."""
+        dropped = len(self._pending)
+        self._pending.clear()
+        return dropped

@@ -303,3 +303,52 @@ class TestTransportRegressionGuard:
         bench.transport_regression_guard(diag)
         assert not [e for e in diag["errors"]
                     if "TRANSPORT REGRESSION" in e]
+
+
+class TestResilienceRegressionGuard:
+    """ISSUE 4 satellite: the finite-check budget guard (<1% of the
+    update stage) fails on TPU, warns on the CPU fallback, and stays
+    silent when the stage never ran."""
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "resilience_guarded_sec_per_update": 0.0101,
+                "resilience_plain_sec_per_update": 0.01}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self):
+        diag = self._diag(resilience_finite_check_frac=0.05)
+        bench.resilience_regression_guard(diag)
+        assert any("RESILIENCE" in e for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self):
+        diag = self._diag(platform="cpu",
+                          resilience_finite_check_frac=0.05)
+        bench.resilience_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("RESILIENCE" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self):
+        diag = self._diag(resilience_finite_check_frac=0.004)
+        bench.resilience_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_negative_frac_is_silent(self):
+        """Timing noise can make the guarded program measure FASTER —
+        that is not a breach."""
+        diag = self._diag(resilience_finite_check_frac=-0.01)
+        bench.resilience_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.resilience_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_slow_skip_path_warns(self):
+        diag = self._diag(resilience_finite_check_frac=0.001,
+                          resilience_skip_vs_normal=2.0)
+        bench.resilience_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("skipped update" in w for w in diag["warnings"])

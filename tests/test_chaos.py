@@ -1,0 +1,606 @@
+"""ISSUE 4: the self-healing training loop, driven by deterministic
+fault injection (``runtime/faults.py``).
+
+Everything here carries the ``chaos`` marker.  The fast deterministic
+subset (injector grammar, the learner's fused non-finite guard,
+checkpoint integrity + walk-back, actor retry, driver rollback/exit-71)
+is tier-1; the full four-fault driver soak + torn-checkpoint resume is
+additionally marked ``slow``.
+"""
+
+import dataclasses
+import functools
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.driver import train as run_train
+from scalable_agent_tpu.driver import zero_trajectory
+from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.models import ImpalaAgent
+from scalable_agent_tpu.models import agent as agent_mod
+from scalable_agent_tpu.obs import get_flight_recorder, get_registry
+from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+from scalable_agent_tpu.runtime import (
+    ActorPool,
+    FaultInjector,
+    InjectedFault,
+    Learner,
+    LearnerHyperparams,
+    NonFiniteTracker,
+    configure_faults,
+    get_fault_injector,
+)
+from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
+from scalable_agent_tpu.runtime.faults import parse_chaos_spec
+
+pytestmark = pytest.mark.chaos
+
+NUM_ACTIONS = 4
+FRAME = TensorSpec((8, 8, 3), np.uint8, "frame")
+
+
+class _ObsSpec:
+    frame = FRAME
+    instruction = None
+    measurements = None
+
+
+def _counter_value(name: str) -> float:
+    return float(get_registry().snapshot().get(name, 0.0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No chaos spec may leak between tests (the injector is a process
+    global, like the other obs singletons)."""
+    configure_faults("")
+    yield
+    configure_faults("")
+
+
+@pytest.fixture(scope="module")
+def learner_setup():
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+    traj = zero_trajectory(Config(), _ObsSpec, agent, batch=4)
+    mesh = make_mesh(MeshSpec(data=4, model=1), devices=jax.devices()[:4])
+    learner = Learner(
+        agent, LearnerHyperparams(total_environment_frames=1e6), mesh,
+        frames_per_update=16)
+    return learner, traj
+
+
+def _nan_trajectory(traj):
+    return traj._replace(env_outputs=traj.env_outputs._replace(
+        reward=traj.env_outputs.reward + np.float32("nan")))
+
+
+# ---------------------------------------------------------------------------
+# Fault injector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_grammar(self):
+        points = parse_chaos_spec(
+            "nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20")
+        assert points == {
+            "nan_grad": frozenset({7}),
+            "actor_raise": frozenset({3, 12}),
+            "ckpt_torn": frozenset({1}),
+            "worker_kill": frozenset({20}),
+        }
+        # Duplicate points merge; empty entries/spec are fine.
+        assert parse_chaos_spec("p@1;p@3")["p"] == frozenset({1, 3})
+        assert parse_chaos_spec("") == {}
+        assert parse_chaos_spec(" ; ") == {}
+
+    @pytest.mark.parametrize("bad", ["p", "p@", "p@0", "p@1:,2", "@3",
+                                     "p@x", "p@1 2"])
+    def test_malformed_spec_raises(self, bad):
+        with pytest.raises(ValueError, match="chaos_spec"):
+            parse_chaos_spec(bad)
+
+    def test_occurrence_firing_is_deterministic(self):
+        injector = FaultInjector("p@2:4")
+        fired = [injector.should_fire("p") for _ in range(6)]
+        assert fired == [False, True, False, True, False, False]
+        # A fresh injector with the same spec replays identically.
+        again = FaultInjector("p@2:4")
+        assert [again.should_fire("p") for _ in range(6)] == fired
+
+    def test_maybe_raise(self):
+        injector = FaultInjector("boom@1")
+        with pytest.raises(InjectedFault, match="boom"):
+            injector.maybe_raise("boom")
+        injector.maybe_raise("boom")  # occurrence 2: no raise
+        assert injector.counts() == {"boom": 2}
+
+    def test_unconfigured_point_never_fires(self):
+        injector = FaultInjector("other@1")
+        assert not injector.should_fire("p")
+
+    def test_disabled_injector_is_inert(self):
+        injector = configure_faults("")
+        assert not injector.active
+        assert not injector.should_fire("anything")
+        assert injector.counts() == {}
+
+    def test_configure_installs_global(self):
+        injector = configure_faults("p@1")
+        assert get_fault_injector() is injector
+        configure_faults("")
+        assert not get_fault_injector().active
+
+
+# ---------------------------------------------------------------------------
+# Learner non-finite guard
+# ---------------------------------------------------------------------------
+
+
+class TestNonFiniteGuard:
+    def test_nan_batch_is_skipped_params_held_frames_exact(
+            self, learner_setup):
+        learner, traj = learner_setup
+        state = learner.init(jax.random.key(0), traj)
+        state, m = learner.update(state, learner.put_trajectory(traj))
+        assert float(np.asarray(m["update_skipped"])) == 0.0
+        # Host copies BEFORE the next update: the jitted update donates
+        # its state argument.
+        params_before = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).copy(), state.params)
+        opt_before = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).copy(), state.opt_state)
+        frames_before = float(np.asarray(state.env_frames))
+
+        bad = learner.put_trajectory(_nan_trajectory(traj))
+        state, m = learner.update(state, bad)
+        assert float(np.asarray(m["update_skipped"])) == 1.0
+        assert float(np.asarray(m["nonfinite_streak"])) == 1.0
+        # params/opt_state are bit-for-bit unchanged...
+        for before, after in zip(
+                jax.tree_util.tree_leaves(params_before),
+                jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(before, np.asarray(after))
+        for before, after in zip(
+                jax.tree_util.tree_leaves(opt_before),
+                jax.tree_util.tree_leaves(state.opt_state)):
+            np.testing.assert_array_equal(before, np.asarray(after))
+        # ...but frame accounting still retired the batch, exactly.
+        assert float(np.asarray(state.env_frames)) == frames_before + 16
+
+    def test_streak_resets_on_finite_update(self, learner_setup):
+        learner, traj = learner_setup
+        state = learner.init(jax.random.key(1), traj)
+        bad = learner.put_trajectory(_nan_trajectory(traj))
+        state, m = learner.update(state, bad)
+        bad = learner.put_trajectory(_nan_trajectory(traj))
+        state, m = learner.update(state, bad)
+        assert float(np.asarray(m["nonfinite_streak"])) == 2.0
+        assert float(np.asarray(m["nonfinite_skips"])) == 2.0
+        state, m = learner.update(state, learner.put_trajectory(traj))
+        assert float(np.asarray(m["nonfinite_streak"])) == 0.0
+        # Cumulative count survives the recovery.
+        assert float(np.asarray(m["nonfinite_skips"])) == 2.0
+
+    def test_nan_grad_injection_point(self, learner_setup):
+        learner, traj = learner_setup
+        state = learner.init(jax.random.key(2), traj)
+        configure_faults("nan_grad@2")
+        state, m = learner.update(state, learner.put_trajectory(traj))
+        assert float(np.asarray(m["update_skipped"])) == 0.0
+        state, m = learner.update(state, learner.put_trajectory(traj))
+        assert float(np.asarray(m["update_skipped"])) == 1.0
+
+    def test_guard_can_be_disabled(self, learner_setup):
+        _, traj = learner_setup
+        agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+        mesh = make_mesh(MeshSpec(data=4, model=1),
+                         devices=jax.devices()[:4])
+        learner = Learner(
+            agent, LearnerHyperparams(total_environment_frames=1e6),
+            mesh, frames_per_update=16, finite_guard=False)
+        state = learner.init(jax.random.key(0), traj)
+        state, m = learner.update(
+            state, learner.put_trajectory(_nan_trajectory(traj)))
+        assert "update_skipped" not in m
+        # Unguarded, the NaN poisons the params — the behavior the
+        # guard exists to prevent.
+        leaf = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+        assert not np.all(np.isfinite(leaf))
+
+
+class TestNonFiniteTracker:
+    def test_counts_deltas_and_exhaustion(self):
+        tracker = NonFiniteTracker(tolerance=3)
+        before = _counter_value("learner/nonfinite_skips_total")
+        assert not tracker.observe(
+            {"nonfinite_skips": 2.0, "nonfinite_streak": 2.0})
+        assert _counter_value(
+            "learner/nonfinite_skips_total") == before + 2.0
+        # Same cumulative value again: no double count.
+        assert not tracker.observe(
+            {"nonfinite_skips": 2.0, "nonfinite_streak": 2.0})
+        assert _counter_value(
+            "learner/nonfinite_skips_total") == before + 2.0
+        assert tracker.observe(
+            {"nonfinite_skips": 3.0, "nonfinite_streak": 3.0})
+
+    def test_rebase_after_rollback(self):
+        tracker = NonFiniteTracker(tolerance=2)
+        before = _counter_value("learner/nonfinite_skips_total")
+        tracker.observe({"nonfinite_skips": 5.0, "nonfinite_streak": 2.0})
+        tracker.rebase(1.0)  # restored checkpoint carries 1 skip
+        tracker.observe({"nonfinite_skips": 2.0, "nonfinite_streak": 1.0})
+        assert _counter_value(
+            "learner/nonfinite_skips_total") == before + 6.0
+
+    def test_zero_tolerance_disables_policy(self):
+        tracker = NonFiniteTracker(tolerance=0)
+        assert not tracker.observe(
+            {"nonfinite_skips": 99.0, "nonfinite_streak": 99.0})
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def ckpt_setup(tmp_path, learner_setup):
+    learner, traj = learner_setup
+    state = learner.init(jax.random.key(0), traj)
+    ckpt = CheckpointManager(str(tmp_path), interval_s=0.0, keep=5)
+    yield ckpt, learner, state, traj
+    ckpt.close()
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_written_and_clean_restore(self, ckpt_setup):
+        ckpt, learner, state, traj = ckpt_setup
+        assert ckpt.maybe_save(1, state)
+        ckpt.wait()
+        manifest_dir = os.path.join(ckpt._dir, "manifests")
+        assert os.path.exists(os.path.join(manifest_dir, "1.json"))
+        template = learner.init(jax.random.key(0), traj)
+        step, restored = ckpt.restore(target=template)
+        assert step == 1
+        for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_torn_latest_walks_back(self, ckpt_setup):
+        ckpt, learner, state, traj = ckpt_setup
+        ckpt.maybe_save(1, state)
+        state, _ = learner.update(state, learner.put_trajectory(traj))
+        ckpt.maybe_save(2, state)
+        ckpt.wait()
+        before = _counter_value("checkpoint/restore_fallbacks_total")
+        ckpt._tear_step(2)
+        template = learner.init(jax.random.key(0), traj)
+        step, restored = ckpt.restore(target=template)
+        assert step == 1
+        assert _counter_value(
+            "checkpoint/restore_fallbacks_total") == before + 1
+        kinds = {e["kind"] for e in get_flight_recorder().snapshot()}
+        assert "ckpt_fallback" in kinds
+        # The torn newer step was quarantined: were it left as
+        # latest_step, Orbax would silently skip (save() -> False)
+        # every resumed save at a step <= 2 — including a final forced
+        # one — while the manifest got rewritten for data never
+        # written.
+        assert ckpt.latest_verified_step() == 1
+        ckpt._last_save = 0.0
+        assert ckpt.maybe_save(2, state, force=True)
+        ckpt.wait()
+        step, _ = ckpt.restore(target=template)
+        assert step == 2  # the re-save really landed on disk
+
+    def test_every_step_torn_raises_loudly(self, ckpt_setup):
+        """When retained steps exist but NONE verifies, restore must
+        raise rather than return None — a silent fresh start would
+        retrain into the logdir and let rotation delete the evidence."""
+        from scalable_agent_tpu.runtime.checkpoint import (
+            CheckpointIntegrityError,
+        )
+
+        ckpt, learner, state, traj = ckpt_setup
+        ckpt.maybe_save(1, state)
+        ckpt.maybe_save(2, state, force=True)
+        ckpt.wait()
+        ckpt._tear_step(1)
+        ckpt._tear_step(2)
+        template = learner.init(jax.random.key(0), traj)
+        with pytest.raises(CheckpointIntegrityError,
+                           match="none restored"):
+            ckpt.restore(target=template)
+
+    def test_legacy_pre_guard_checkpoint_migrates(self, ckpt_setup):
+        """A checkpoint saved with the 3-field pre-guard TrainState must
+        restore (guard counters zero-filled), not read as torn."""
+        import typing
+
+        import orbax.checkpoint as ocp
+
+        class LegacyTrainState(typing.NamedTuple):  # the pre-PR layout
+            params: object
+            opt_state: object
+            env_frames: object
+
+        ckpt, learner, state, traj = ckpt_setup
+        legacy = LegacyTrainState(
+            params=jax.tree_util.tree_map(np.asarray, state.params),
+            opt_state=jax.tree_util.tree_map(
+                np.asarray, state.opt_state),
+            env_frames=np.asarray(128.0, np.float32),
+        )
+        ckpt._manager.save(7, args=ocp.args.StandardSave(legacy))
+        ckpt.wait()
+        template = learner.init(jax.random.key(0), traj)
+        step, restored = ckpt.restore(target=template)
+        assert step == 7
+        assert float(np.asarray(restored.env_frames)) == 128.0
+        assert float(np.asarray(restored.nonfinite_skips)) == 0.0
+        # The migrated state places back onto the mesh cleanly.
+        placed = learner.place_state(restored)
+        assert float(np.asarray(placed.nonfinite_streak)) == 0.0
+
+    def test_missing_manifest_is_accepted(self, ckpt_setup):
+        """Checkpoints written before the manifest existed must still
+        restore (legacy acceptance)."""
+        ckpt, learner, state, traj = ckpt_setup
+        ckpt.maybe_save(1, state)
+        ckpt.wait()
+        os.remove(os.path.join(ckpt._dir, "manifests", "1.json"))
+        template = learner.init(jax.random.key(0), traj)
+        step, _ = ckpt.restore(target=template)
+        assert step == 1
+
+    def test_save_failure_degrades_then_forced_reraises(self, ckpt_setup):
+        ckpt, learner, state, traj = ckpt_setup
+        before = _counter_value("checkpoint/save_failures_total")
+        configure_faults("ckpt_save_fail@1:2")
+        assert not ckpt.maybe_save(1, state)
+        assert _counter_value(
+            "checkpoint/save_failures_total") == before + 1
+        # The failed cadenced save backs off a full interval but does
+        # not poison later saves...
+        ckpt._last_save = 0.0
+        with pytest.raises(InjectedFault):
+            ckpt.maybe_save(2, state, force=True)  # ...forced re-raises
+        configure_faults("")
+        ckpt._last_save = 0.0
+        assert ckpt.maybe_save(3, state)
+
+    def test_ckpt_torn_injection_corrupts_on_disk(self, ckpt_setup):
+        ckpt, learner, state, traj = ckpt_setup
+        ckpt.maybe_save(1, state)
+        configure_faults("ckpt_torn@1")
+        ckpt._last_save = 0.0
+        ckpt.maybe_save(2, state)
+        configure_faults("")
+        template = learner.init(jax.random.key(0), traj)
+        step, _ = ckpt.restore(target=template)
+        assert step == 1
+
+
+# ---------------------------------------------------------------------------
+# Actor retry
+# ---------------------------------------------------------------------------
+
+
+def _make_envs(n=2, workers=1):
+    fns = [functools.partial(
+        make_impala_stream, "fake_small", seed=i, height=8, width=8,
+        num_actions=NUM_ACTIONS, episode_length=3) for i in range(n)]
+    return MultiEnv(fns, FRAME, num_workers=workers)
+
+
+def _make_pool(envs, **kwargs):
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+    out0 = envs.initial()
+    batch = envs.num_envs
+    params = agent.init(
+        jax.random.key(0),
+        np.zeros((1, batch), np.int32),
+        jax.tree_util.tree_map(
+            lambda x: None if x is None else np.asarray(x)[None],
+            out0, is_leaf=lambda x: x is None),
+        agent_mod.initial_state(batch))
+    kwargs.setdefault("restart_backoff_s", 0.01)
+    pool = ActorPool(agent, [envs], unroll_length=3, seed=1, **kwargs)
+    pool.set_params(params)
+    return pool
+
+
+class TestActorRetry:
+    def test_transient_raise_is_retried(self):
+        envs = _make_envs()
+        pool = _make_pool(envs, max_restarts=2)
+        before = _counter_value("actor/restarts_total")
+        configure_faults("actor_raise@1")
+        pool.start()
+        try:
+            out = pool.get_trajectory(timeout=120)
+            assert out.env_outputs.reward.shape == (4, 2)
+            assert _counter_value("actor/restarts_total") == before + 1
+            kinds = {e["kind"]
+                     for e in get_flight_recorder().snapshot()}
+            assert "actor_restart" in kinds
+        finally:
+            pool.stop()
+
+    def test_budget_exhaustion_surfaces_terminal_failure(self):
+        envs = _make_envs()
+        pool = _make_pool(envs, max_restarts=1)
+        configure_faults("actor_raise@1:2")
+        pool.start()
+        try:
+            with pytest.raises(InjectedFault):
+                pool.get_trajectory(timeout=120)
+        finally:
+            pool.stop()
+
+    def test_zero_budget_fails_fast(self):
+        envs = _make_envs()
+        pool = _make_pool(envs, max_restarts=0)
+        configure_faults("actor_raise@1")
+        pool.start()
+        try:
+            with pytest.raises(InjectedFault):
+                pool.get_trajectory(timeout=120)
+        finally:
+            pool.stop()
+
+    def test_restarts_outside_window_do_not_exhaust_budget(self):
+        """The budget detects crash loops, not lifetime faults (same
+        semantics as MultiEnv's respawn window): raises spaced wider
+        than the window never add up to a kill."""
+        envs = _make_envs()
+        # Backoff (0.1s) > window (0.05s): by the time the next raise
+        # can occur the previous restart has aged out of the window.
+        pool = _make_pool(envs, max_restarts=1, restart_backoff_s=0.1,
+                          restart_window_s=0.05)
+        before = _counter_value("actor/restarts_total")
+        configure_faults("actor_raise@1:3:5")
+        pool.start()
+        try:
+            for _ in range(3):
+                out = pool.get_trajectory(timeout=120)
+                assert not isinstance(out, Exception)
+            assert _counter_value("actor/restarts_total") == before + 3
+        finally:
+            pool.stop()
+
+    def test_worker_kill_respawns_and_counts(self):
+        envs = _make_envs(n=2, workers=1)
+        pool = _make_pool(envs, max_restarts=2)
+        before = _counter_value("env/worker_respawns_total")
+        configure_faults("worker_kill@2")
+        pool.start()
+        try:
+            for _ in range(4):
+                pool.get_trajectory(timeout=120)
+            assert envs.total_respawns >= 1
+            assert _counter_value(
+                "env/worker_respawns_total") >= before + 1
+            kinds = {e["kind"]
+                     for e in get_flight_recorder().snapshot()}
+            assert "worker_respawn" in kinds
+        finally:
+            pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Driver: rollback + exit 71 (tier-1 acceptance), four-fault soak (slow)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_config(tmp_path, **overrides) -> Config:
+    defaults = dict(
+        mode="train",
+        logdir=str(tmp_path / "run"),
+        level_name="fake_small",
+        num_actors=4,
+        batch_size=2,
+        unroll_length=4,
+        num_action_repeats=1,
+        total_environment_frames=40,  # 5 updates of 8 frames
+        height=16,
+        width=16,
+        num_env_workers_per_group=2,
+        compute_dtype="float32",
+        checkpoint_interval_s=0.0,  # save every update
+        log_interval_s=0.0,  # observe guard metrics every update
+        seed=5,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+class TestDriverRollback:
+    def test_consecutive_skips_roll_back_and_train_completes(
+            self, tmp_path):
+        config = _chaos_config(
+            tmp_path, total_environment_frames=48,
+            chaos_spec="nan_grad@3:4", nonfinite_tolerance=2)
+        skips_before = _counter_value("learner/nonfinite_skips_total")
+        rollbacks_before = _counter_value("learner/rollbacks_total")
+        metrics = run_train(config)
+        assert metrics["env_frames"] == 48
+        assert np.isfinite(metrics["total_loss"])
+        assert _counter_value(
+            "learner/nonfinite_skips_total") == skips_before + 2
+        assert _counter_value(
+            "learner/rollbacks_total") == rollbacks_before + 1
+        kinds = {e["kind"] for e in get_flight_recorder().snapshot()}
+        assert "rollback" in kinds and "nonfinite_skip" in kinds
+
+    def test_no_rollback_exits_71(self, tmp_path):
+        config = _chaos_config(
+            tmp_path, chaos_spec="nan_grad@2:3",
+            nonfinite_tolerance=2, no_rollback=True)
+        with pytest.raises(SystemExit) as excinfo:
+            run_train(config)
+        assert excinfo.value.code == 71
+        # The forensic dump fired before the exit.
+        recorder = get_flight_recorder()
+        assert recorder.last_dump_reason == "nonfinite:no_rollback"
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_four_fault_soak_then_torn_resume(self, tmp_path):
+        """ISSUE 4 acceptance: ONE driver run injecting a NaN grad, a
+        transient actor exception, a SIGKILL'd env worker, and a torn
+        latest checkpoint trains to completion; the follow-up run
+        resumes from the older valid checkpoint — with each recovery
+        visible as its counter + flight-recorder event."""
+        # 5 updates of 8 frames; saves fire per update (interval 0) so
+        # the save sequence is steps 1..5 then the forced final at step
+        # 5 again — ckpt_torn@6 tears the LATEST retained step.
+        config = _chaos_config(
+            tmp_path,
+            chaos_spec=("nan_grad@2;actor_raise@1;worker_kill@3;"
+                        "ckpt_torn@6"),
+            actor_max_restarts=2)
+        before = {
+            name: _counter_value(name) for name in (
+                "learner/nonfinite_skips_total",
+                "actor/restarts_total",
+                "env/worker_respawns_total",
+                "faults/injected_total",
+            )}
+        metrics = run_train(config)
+        assert metrics["env_frames"] == 40
+        assert np.isfinite(metrics["total_loss"])
+        assert _counter_value("learner/nonfinite_skips_total") == (
+            before["learner/nonfinite_skips_total"] + 1)
+        assert _counter_value("actor/restarts_total") == (
+            before["actor/restarts_total"] + 1)
+        assert _counter_value("env/worker_respawns_total") >= (
+            before["env/worker_respawns_total"] + 1)
+        assert _counter_value("faults/injected_total") == (
+            before["faults/injected_total"] + 4)
+        kinds = {e["kind"] for e in get_flight_recorder().snapshot()}
+        assert {"fault", "nonfinite_skip", "actor_restart",
+                "worker_respawn"} <= kinds
+
+        # Resume on the same logdir: the torn latest step must be
+        # rejected and the older valid step restored.
+        fallbacks_before = _counter_value(
+            "checkpoint/restore_fallbacks_total")
+        config2 = dataclasses.replace(
+            config, total_environment_frames=56.0, chaos_spec="")
+        metrics2 = run_train(config2)
+        assert metrics2["env_frames"] == 56
+        assert _counter_value("checkpoint/restore_fallbacks_total") == (
+            fallbacks_before + 1)
+        # The walk-back landed one step below the torn latest (5 -> 4).
+        assert _counter_value("checkpoint/restored_step") == 4.0
+        kinds2 = {e["kind"] for e in get_flight_recorder().snapshot()}
+        assert "ckpt_fallback" in kinds2
