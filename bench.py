@@ -1331,6 +1331,82 @@ def bench_resilience(diag, budget_s=90.0):
     diag["resilience_secs"] = round(time.perf_counter() - t_start, 1)
 
 
+def bench_fleet(diag):
+    """Fleet fault-domain stage (ISSUE 5): the peer-health layer's unit
+    costs and their implied share of the update stage.  The layer puts
+    exactly three things near the hot path — the per-iteration
+    ``preemption_requested()`` check, the ``collective()`` guard's
+    arm/disarm around each blocking cross-process point, and the
+    publisher/monitor threads' ~per-second cycles (amortized onto
+    updates at their real cadence).  Pure host timing against an
+    in-memory KV fake, <1s, backend-independent — the acceptance
+    budget is < 0.5% of the update stage."""
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.runtime.fleet import FleetMonitor
+
+    class _FakeKV:
+        def __init__(self):
+            self.store = {}
+
+        def key_value_set(self, key, value, allow_overwrite=False):
+            self.store[key] = value
+
+        def key_value_dir_get(self, prefix):
+            return [(k, v) for k, v in self.store.items()
+                    if k.startswith(prefix)]
+
+    registry = MetricsRegistry()
+    # A 4-process fleet's worth of peers, never started (threads poll
+    # at ~1 Hz — this times the per-call primitives, not the idle
+    # threads, the same discipline as bench_obs's watchdog number).
+    monitor = FleetMonitor(
+        peer_timeout_s=60.0, preemption_grace_s=30.0,
+        registry=registry, process_index=0, num_processes=4,
+        kv=_FakeKV(), on_fatal=lambda code: None)
+    for peer in range(1, 4):
+        monitor._kv.key_value_set(f"fleet/hb/{peer}", "1")
+
+    n = 20000
+
+    def per_call_us(fn):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e6
+
+    diag["fleet_preempt_check_us"] = round(
+        per_call_us(monitor.preemption_requested), 3)
+
+    def guarded_noop():
+        with monitor.collective("bench"):
+            pass
+
+    diag["fleet_collective_guard_us"] = round(
+        per_call_us(guarded_noop), 3)
+    diag["fleet_heartbeat_publish_us"] = round(
+        per_call_us(monitor.publish_once), 3)
+    diag["fleet_monitor_pass_us"] = round(
+        per_call_us(monitor.monitor_once), 3)
+
+    sec_per_update = diag.get("sec_per_update")
+    if sec_per_update:
+        # Hot path per update: one preempt check + ~2 armed collectives
+        # (put_trajectory + retire); the decision broadcast's guard is
+        # 1/8-cadenced.  Thread cycles run at their own ~1 Hz cadence
+        # CONCURRENTLY with the update, so their per-update share is
+        # (cycle cost) x (cycles per update).
+        publish_hz = 1.0 / monitor._publish_s
+        poll_hz = 1.0 / monitor._poll_s
+        per_update_s = (
+            diag["fleet_preempt_check_us"]
+            + 2.125 * diag["fleet_collective_guard_us"]) / 1e6
+        thread_s_per_update = sec_per_update * (
+            publish_hz * diag["fleet_heartbeat_publish_us"]
+            + poll_hz * diag["fleet_monitor_pass_us"]) / 1e6
+        diag["fleet_overhead_frac_on_update"] = round(
+            (per_update_s + thread_s_per_update) / sec_per_update, 6)
+
+
 # The finite check's budget on the update stage (ISSUE 4 acceptance).
 RESILIENCE_BUDGET_FRAC = 0.01
 
@@ -1363,6 +1439,37 @@ def resilience_regression_guard(diag):
         diag.setdefault("warnings", []).append(
             f"resilience: a skipped update runs {ratio}x a normal one "
             f"(expected ~1x — the guard's selects should be free)")
+
+
+# The fleet layer's budget on the update stage (ISSUE 5 acceptance):
+# heartbeat publish + monitor + hot-path guards must stay under 0.5%.
+FLEET_BUDGET_FRAC = 0.005
+
+
+def fleet_regression_guard(diag):
+    """ISSUE 5 acceptance: fail the bench when the fleet layer
+    (heartbeat publish + monitor cycles amortized at their real
+    cadence, plus the per-update preempt check and collective guards)
+    exceeds 0.5% of the update stage.  Same platform discipline as the
+    resilience guard: binding on TPU, advisory on the CPU fallback
+    where sec_per_update is small enough that host-timer jitter
+    dominates the ratio."""
+    frac = diag.get("fleet_overhead_frac_on_update")
+    if frac is None:
+        return  # stage never ran (its own error already recorded)
+    if frac > FLEET_BUDGET_FRAC:
+        msg = (
+            f"FLEET: fault-domain layer overhead {frac:.3%} of the "
+            f"update stage exceeds the {FLEET_BUDGET_FRAC:.1%} budget "
+            f"(publish {diag.get('fleet_heartbeat_publish_us')}us, "
+            f"monitor {diag.get('fleet_monitor_pass_us')}us, guard "
+            f"{diag.get('fleet_collective_guard_us')}us)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory, the tiny "
+                "sec_per_update makes the ratio jitter-bound")
+        else:
+            diag["errors"].append(msg)
 
 
 def transport_regression_guard(diag, bench_dir=None):
@@ -1791,6 +1898,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_resilience failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_fleet"
+    try:
+        bench_fleet(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_fleet failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "e2e_link_retry"
     try:
         maybe_retry_e2e(diag, start_monotonic, deadline)
@@ -1823,6 +1936,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "resilience regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "fleet_regression_guard"
+    try:
+        fleet_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "fleet regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()

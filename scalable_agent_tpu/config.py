@@ -197,6 +197,33 @@ class Config:
     # 'nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20'.
     # Empty = no faults.
     chaos_spec: str = ""
+    # -- fleet fault domains (runtime/fleet.py, docs/robustness.md) ------
+    # Peer heartbeat deadline: in a multi-process run, a peer whose
+    # KV-store heartbeat stops advancing for this long (local monotonic
+    # clock) is declared lost — forensic dump + exit 72 instead of
+    # hanging forever in the next collective.  0 disables detection
+    # (single-process runs never arm it).
+    peer_timeout_s: float = 60.0
+    # Preemption grace: SIGTERM raises a fleet-wide preemption flag
+    # instead of dumping and dying; every process drains its in-flight
+    # window and takes ONE coordinated final verified checkpoint within
+    # this many seconds, then exits 0 for frame-exact resume.  Blowing
+    # the window means forensics + exit 72; a second SIGTERM escalates
+    # to the legacy immediate dump.  0 restores dump-and-exit(143).
+    preemption_grace_s: float = 30.0
+    # Deadline on each blocking cross-process point (decision
+    # broadcasts, trajectory assembly, checkpoint save/restore
+    # collectives): a collective older than this is attributed in the
+    # flight recorder and the process exits 72.  0 = auto
+    # (max(600, 4x peer_timeout_s)) — it must sit above a worst-case
+    # first-update compile or Orbax read, not above a step; the
+    # heartbeat deadline above is the FAST detector.
+    collective_timeout_s: float = 0.0
+    # Bounded retry (capped exponential backoff) around
+    # jax.distributed.initialize: process N racing the coordinator's
+    # startup retries for this long before failing the run
+    # (fleet/init_retries_total counts the attempts).
+    coordinator_init_timeout_s: float = 60.0
 
     # -------------------------------------------------------------------
 

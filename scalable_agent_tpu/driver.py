@@ -34,6 +34,18 @@ Self-healing flags (docs/robustness.md):
         capped exponential backoff.
     --chaos_spec='point@i[:j...];...'   deterministic fault injection
         (runtime/faults.py) for chaos testing the recovery paths.
+
+Fleet fault-domain flags (runtime/fleet.py, docs/robustness.md):
+    --peer_timeout_s=T        multi-process peer heartbeat deadline: a
+        peer silent for T seconds triggers forensics + exit 72 in every
+        survivor instead of an unbounded collective hang.
+    --preemption_grace_s=G    SIGTERM raises a fleet-wide preemption
+        flag; all processes drain and take ONE coordinated final
+        checkpoint within G seconds, then exit 0 (frame-exact resume).
+        0 restores the legacy dump-and-exit(143).
+    --collective_timeout_s=C  deadline on each blocking cross-process
+        point (0 = auto); --coordinator_init_timeout_s bounds the
+        initialize retry loop.
 """
 
 import argparse
@@ -84,8 +96,10 @@ from scalable_agent_tpu.runtime import (
     TrainState,
     Trajectory,
     configure_faults,
+    configure_fleet,
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
+from scalable_agent_tpu.runtime.exit_codes import NONFINITE_EXIT_CODE
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -502,11 +516,11 @@ def _teardown_observability(config: Config, handles: _ObsHandles):
         handles.uninstall_handlers()
 
 
-# Exit code for a run ended by the non-finite guard (tolerance exhausted
-# with --no_rollback, or no checkpoint left to roll back to).  Distinct
-# from the watchdog's 70 so a supervisor can tell a numeric divergence
-# from a hang.
-NONFINITE_EXIT_CODE = 71
+# NONFINITE_EXIT_CODE (71, re-exported above from runtime/exit_codes.py
+# — the one registry for watchdog 70 / non-finite 71 / fleet 72): a run
+# ended by the non-finite guard with --no_rollback, or with no
+# checkpoint left to roll back to.  Distinct codes let a supervisor
+# tell a numeric divergence from a hang from a lost peer.
 
 
 def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
@@ -600,7 +614,8 @@ def train(config: Config) -> Dict[str, float]:
         config.distributed_coordinator or None,
         config.distributed_num_processes or None,
         config.distributed_process_id
-        if config.distributed_process_id >= 0 else None)
+        if config.distributed_process_id >= 0 else None,
+        init_timeout_s=config.coordinator_init_timeout_s)
 
     config = apply_env_overrides(config)
     if is_coordinator():
@@ -616,6 +631,18 @@ def train(config: Config) -> Dict[str, float]:
     # the trace file and dumps the flight recorder.
     obs_handles = _setup_observability(config, is_coordinator())
     registry, prom = obs_handles.registry, obs_handles.prom
+    # Fleet fault domains (runtime/fleet.py): peer heartbeats over the
+    # jax.distributed KV store, collective deadlines, and the SIGTERM
+    # preemption-grace protocol.  Up BEFORE the learner/restore so a
+    # peer lost during the (collective) restore or first compile is
+    # already bounded; its SIGTERM handler layers over the crash
+    # handlers _setup_observability just installed.
+    fleet = configure_fleet(
+        config.peer_timeout_s,
+        preemption_grace_s=config.preemption_grace_s,
+        collective_timeout_s=config.collective_timeout_s,
+        registry=registry,
+        recorder=get_flight_recorder())
     pool = prefetch_thread = writer = ckpt = None
     prefetch_stop = threading.Event()
     profiling = False
@@ -633,15 +660,32 @@ def train(config: Config) -> Dict[str, float]:
 
         learner = build_training_learner(config, agent)
 
+        # gloo (the multi-process CPU collectives transport) pairs ops
+        # by ARRIVAL order per process-pair: no two programs with
+        # collectives may ever be in flight at once, or their ops
+        # mispair across processes and abort the whole fleet with a
+        # size mismatch.  TPU/GPU streams serialize collectives in
+        # issue order, so only the CPU rig pays these explicit
+        # materialization barriers (here and in the update loop).
+        cpu_lockstep = (jax.process_count() > 1
+                        and jax.devices()[0].platform == "cpu")
+
         ckpt = CheckpointManager(config.logdir,
                                  config.checkpoint_interval_s,
                                  config.checkpoint_keep)
         example = zero_trajectory(config, observation_spec, agent)
         state = learner.init(jax.random.key(config.seed), example)
+        if cpu_lockstep:
+            # init is a global-mesh program whose collectives would
+            # otherwise still be draining when restore()'s has_any
+            # broadcast posts its own ops.
+            jax.block_until_ready(state)
         restored = ckpt.restore(target=state)
         if restored is not None:
             start_updates, host_state = restored
             state = learner.place_state(host_state)
+            if cpu_lockstep:
+                jax.block_until_ready(state)
             log.info("restored checkpoint at update %d (%.0f frames)",
                      start_updates, _host_scalar(state.env_frames))
         else:
@@ -716,7 +760,18 @@ def train(config: Config) -> Dict[str, float]:
         # the loop blocks ("retire") only when the window fills, so the
         # next batch's staging overlaps the running update while
         # backpressure and per-update metrics ordering stay exact.
-        inflight = InflightWindow(config.inflight_updates,
+        # Same gloo arrival-order hazard as above: neither two
+        # overlapping update executions (inflight window) nor an async
+        # update racing the loop's next blocking broadcast may coexist
+        # on the CPU rig.
+        inflight_updates = config.inflight_updates
+        if inflight_updates > 1 and cpu_lockstep:
+            log.warning(
+                "inflight_updates=%d downgraded to 1: multi-process "
+                "CPU (gloo) runs mispair collectives from overlapping "
+                "update executions", inflight_updates)
+            inflight_updates = 1
+        inflight = InflightWindow(inflight_updates,
                                   registry=registry)
         rollback_wanted = False
         while frames < config.total_environment_frames:
@@ -745,6 +800,13 @@ def train(config: Config) -> Dict[str, float]:
             with timing.time_avg("update"), interval.add_time("update"):
                 state, dispatched = learner.update(state, traj)
             inflight.push(dispatched)
+            if cpu_lockstep:
+                # Materialize the WHOLE update before the loop can
+                # reach another cross-process point (decision
+                # broadcast, save collective): metrics resolving does
+                # not mean the program's last all-reduce has drained,
+                # and gloo mispairs anything that arrives alongside it.
+                jax.block_until_ready(state)
             watchdog.touch("learner")
             pool.set_params(state.params, version=updates)
             updates += 1
@@ -753,9 +815,12 @@ def train(config: Config) -> Dict[str, float]:
                 # Materialize the OLDEST in-flight update's metrics
                 # (FIFO, so the logged metrics always belong to a known
                 # update and env_frames accounting is exact); this is
-                # the loop's only device wait.
+                # the loop's only device wait — in a multi-process run
+                # it materializes the cross-host all-reduce, so a peer
+                # lost mid-update surfaces (and is attributed) here.
                 with timing.time_avg("retire"), \
-                        interval.add_time("retire"):
+                        interval.add_time("retire"), \
+                        fleet.collective("retire_update"):
                     metrics = inflight.retire()
             watchdog.touch("learner")
             if profiling and updates >= profile_stop_at:
@@ -862,26 +927,50 @@ def train(config: Config) -> Dict[str, float]:
                              for k, v in timing_summary.items()),
                     StallAttributor.describe(category, evidence))
                 last_log, frames_at_last_log = now, frames
-            # Rollback at a point EVERY process reaches on the SAME
-            # iteration, with the coordinator's verdict broadcast — the
-            # divergent-local-clocks discipline maybe_save applies to
-            # its save decision — so the collective restore inside
-            # _rollback_or_exit is entered by all processes together.
-            # The multi-host broadcast is gated on the update counter
-            # (identical on every process, unlike wall clocks) every 8
-            # updates, so the hot loop doesn't pay a second per-update
-            # collective; the added detection latency is dwarfed by the
-            # log-interval gate above, and skipped updates are no-ops
-            # anyway.
+            # Rollback AND preemption decisions at a point EVERY
+            # process reaches on the SAME iteration, with the
+            # coordinator's verdict broadcast — the divergent-local-
+            # clocks discipline maybe_save applies to its save decision
+            # — so the collective restore inside _rollback_or_exit (or
+            # the coordinated preemption drain) is entered by all
+            # processes together.  The multi-host broadcast is gated on
+            # the update counter (identical on every process, unlike
+            # wall clocks) every 8 updates, so the hot loop doesn't pay
+            # a second per-update collective; the added detection
+            # latency is dwarfed by the log-interval gate above for
+            # rollback and by the grace window for preemption.  A
+            # SIGTERM'd process must NOT act on its local flag alone:
+            # entering the final-save collective while peers keep
+            # training is exactly the unpaired-collective hang this
+            # layer exists to prevent — the KV flag carries the signal
+            # to the coordinator, whose broadcast verdict commits
+            # everyone at once.
             do_rollback = rollback_wanted
+            do_preempt = fleet.preemption_requested()
             if jax.process_count() > 1:
-                do_rollback = False
+                do_rollback = do_preempt = False
                 if updates % 8 == 0:
                     from jax.experimental import multihost_utils
 
-                    do_rollback = bool(
-                        multihost_utils.broadcast_one_to_all(
-                            np.asarray(rollback_wanted)))
+                    with fleet.collective("decision_broadcast"):
+                        verdict = multihost_utils.broadcast_one_to_all(
+                            np.asarray([rollback_wanted,
+                                        fleet.preemption_requested()]))
+                    do_rollback = bool(verdict[0])
+                    do_preempt = bool(verdict[1])
+            if do_preempt:
+                # Coordinated preemption drain: fall through to the
+                # normal shutdown tail below — in-flight window
+                # drained, ONE forced verified checkpoint (whose
+                # internal broadcast/allgather every process now
+                # reaches together), clean exit 0.  The fleet monitor's
+                # grace deadline bounds this whole tail with exit 72.
+                fleet.note_preempt_decision(updates)
+                log.warning(
+                    "preemption drain: stopping at update %d "
+                    "(%.3g frames) for the coordinated final "
+                    "checkpoint", updates, frames)
+                break
             if do_rollback:
                 rollback_wanted = False
                 state, updates, frames = _rollback_or_exit(
@@ -940,7 +1029,13 @@ def train(config: Config) -> Dict[str, float]:
             # the error and unblocks everyone.
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("train_exit")
+            with fleet.collective("train_exit_barrier"):
+                multihost_utils.sync_global_devices("train_exit")
+        # Fleet teardown LAST: peer-loss detection and the preemption
+        # grace deadline must cover the whole teardown tail — a peer
+        # dying during the final save or exit barrier is still a
+        # bounded exit 72, not a hang.
+        configure_fleet(None)
     return {k: _host_scalar(v) for k, v in metrics.items()}
 
 
@@ -957,6 +1052,20 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     if config.transport not in ("packed", "per_leaf"):
         raise ValueError(
             f"unknown transport {config.transport!r} (packed | per_leaf)")
+    transport = config.transport
+    if (transport == "packed" and jax.process_count() > 1
+            and jax.devices()[0].platform == "cpu"):
+        # Multi-process CPU collectives ride gloo, which pairs ops by
+        # arrival order: the packed transport's jitted unpack (prefetch
+        # thread) running concurrently with the update's all-reduce
+        # (main thread) mispairs them and aborts the whole fleet with a
+        # gloo size-mismatch.  TPU/GPU streams serialize collectives in
+        # issue order, so only the CPU test rig needs the downgrade.
+        log.warning(
+            "transport=packed downgraded to per_leaf: multi-process "
+            "CPU (gloo) runs mispair the concurrent unpack program's "
+            "ops with the update's collectives")
+        transport = "per_leaf"
     if config.inflight_updates < 1:
         raise ValueError(
             f"inflight_updates must be >= 1, got "
@@ -986,7 +1095,7 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     # keeps one source of truth.
     return Learner(agent, hp, mesh, config.frames_per_update(),
                    scan_impl=config.scan_impl,
-                   transport=config.transport)
+                   transport=transport)
 
 
 def train_ingraph(config: Config) -> Dict[str, float]:
@@ -1067,6 +1176,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     # raise in between, so the trace file can't leak.
     obs_handles = _setup_observability(config, coordinator=True)
     registry, prom = obs_handles.registry, obs_handles.prom
+    # Single-process fleet: only the preemption-grace protocol arms
+    # (no peers to heartbeat) — SIGTERM drains to one final verified
+    # checkpoint inside --preemption_grace_s instead of dump-and-die.
+    fleet = configure_fleet(
+        config.peer_timeout_s,
+        preemption_grace_s=config.preemption_grace_s,
+        collective_timeout_s=config.collective_timeout_s,
+        registry=registry,
+        recorder=get_flight_recorder())
     watchdog = get_watchdog()
     nonfinite = NonFiniteTracker(config.nonfinite_tolerance,
                                  registry=registry)
@@ -1119,6 +1237,17 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         " ".join(f"{k} {v:.4f}s"
                                  for k, v in timing_summary.items()))
                     last_log, frames_at_last_log = now, frames
+                if fleet.preemption_requested():
+                    # Same per-iteration decision point as the host
+                    # backend (single-process, so no broadcast): fall
+                    # through to the forced final save below and exit
+                    # cleanly inside the grace window.
+                    fleet.note_preempt_decision(updates)
+                    log.warning(
+                        "preemption drain: stopping at update %d "
+                        "(%.3g frames) for the final checkpoint",
+                        updates, frames)
+                    break
                 ckpt.maybe_save(updates, state)
             # Same shutdown-tail disarm as the host backend: the final
             # forced save must not trip (or be aborted by) the watchdog.
@@ -1129,6 +1258,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         configure_faults("")
         ckpt.close()
         _teardown_observability(config, obs_handles)
+        configure_fleet(None)  # after obs: covers the whole tail
     return _finalize_ingraph_metrics(metrics, config)
 
 

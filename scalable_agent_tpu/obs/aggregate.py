@@ -214,9 +214,15 @@ def _fleet_fold(family: str, metric: str, kind: str,
     """Which fold a fleet-total series takes.  Counters (and summary
     _sum/_count) add up; 'how full is this queue' gauges take the worst
     (max); 'how busy is this consumer' gauges take the most-starved
-    (min); summary quantiles report the worst-case latency (max)."""
+    (min); summary quantiles report the worst-case latency (max);
+    fleet-health gauges (runtime/fleet.py peers_alive) take the MIN —
+    the fleet question is 'what does the most-pessimistic process
+    see', and a process that noticed a dead peer must not be averaged
+    away by ones that haven't polled yet."""
     if kind == "counter" or metric.endswith(("_sum", "_count")):
         return "sum"
+    if "peers_alive" in metric:
+        return "min"
     # Occupancy BEFORE the quantile rule: the runtime's occupancy
     # instruments are histograms (quantile-labelled summaries), and the
     # fleet question is "who is most starved" — min — for every series

@@ -85,6 +85,15 @@ class FlightRecorder:
         # when the in-handler attempt had to be abandoned (see
         # install_crash_handlers).
         self.pending_dump_reason: Optional[str] = None
+        # Root-cause attribution pin.  A terminal verdict (the fleet
+        # monitor's peer-lost/collective-timeout fatal) SETS this; any
+        # later dump still rewrites the file (fresher events win) but
+        # keeps the pinned reason, demoting its own to
+        # ``secondary_reason``.  Without it the symptom cascade — the
+        # aborted collective's XlaRuntimeError unwinding the main
+        # thread AFTER the verdict dump — would clobber the one line
+        # the operator reads first.
+        self.reason_pin: Optional[str] = None
 
     # -- recording (hot path) ----------------------------------------------
 
@@ -147,6 +156,9 @@ class FlightRecorder:
         if not self._dump_lock.acquire(blocking=False):
             return None
         try:
+            secondary = None
+            if self.reason_pin is not None and reason != self.reason_pin:
+                secondary, reason = reason, self.reason_pin
             self.dump_count += 1
             self.last_dump_reason = reason
             try:
@@ -156,6 +168,7 @@ class FlightRecorder:
             payload = {
                 "schema_version": _SCHEMA_VERSION,
                 "reason": reason,
+                **({"secondary_reason": secondary} if secondary else {}),
                 "pid": os.getpid(),
                 "process_index": self.process_index,
                 "dump_count": self.dump_count,
@@ -190,17 +203,25 @@ class FlightRecorder:
             faulthandler.dump_traceback(file=f, all_threads=True)
         return path
 
-    def dump_all(self, reason: str) -> Optional[str]:
+    def dump_all(self, reason: str,
+                 blocking_s: float = 0.0) -> Optional[str]:
         """The full forensic drop: ring JSON + all-thread stacks + a
         final Prometheus snapshot (when an exporter is attached).  Never
         raises — this runs on paths where a second failure must not mask
-        the first.  One writer at a time (non-blocking): two failure
-        triggers firing together (watchdog + SIGTERM, two dying
-        threads) would otherwise interleave writes into the same
-        stacks/prom files and tear exactly the artifacts the operator
-        reads first — the concurrent caller skips, the dump already in
-        flight is current enough."""
-        if not self._dump_all_lock.acquire(blocking=False):
+        the first.  One writer at a time: two failure triggers firing
+        together (watchdog + SIGTERM, two dying threads) would otherwise
+        interleave writes into the same stacks/prom files and tear
+        exactly the artifacts the operator reads first — by default the
+        concurrent caller skips, the dump already in flight is current
+        enough.  A caller whose dump must LAND (the fleet monitor's
+        fatal: its attribution events postdate whatever dump an
+        unwinding exception already wrote) passes ``blocking_s`` to wait
+        that long for the in-flight writer and then re-dump."""
+        if blocking_s > 0.0:
+            acquired = self._dump_all_lock.acquire(timeout=blocking_s)
+        else:
+            acquired = self._dump_all_lock.acquire(blocking=False)
+        if not acquired:
             return None
         try:
             try:

@@ -41,7 +41,15 @@ from scalable_agent_tpu.utils import log
 
 __all__ = ["Watchdog", "configure_watchdog", "get_watchdog"]
 
-_ABORT_EXIT_CODE = 70  # EX_SOFTWARE
+
+def _abort_exit_code() -> int:
+    """The registered watchdog exit code (runtime/exit_codes.py).  Lazy:
+    importing the runtime package at module scope would cycle (runtime
+    imports obs), and by the time a stall actually fires everything is
+    loaded."""
+    from scalable_agent_tpu.runtime.exit_codes import WATCHDOG_EXIT_CODE
+
+    return WATCHDOG_EXIT_CODE
 
 
 class Watchdog:
@@ -167,10 +175,10 @@ class Watchdog:
             except Exception:
                 log.exception("watchdog on_stall callback failed")
         if self._abort:
+            code = _abort_exit_code()
             log.error("watchdog: aborting the run (exit %d) — artifacts "
-                      "in %s", _ABORT_EXIT_CODE,
-                      recorder.logdir or "<no logdir>")
-            os._exit(_ABORT_EXIT_CODE)
+                      "in %s", code, recorder.logdir or "<no logdir>")
+            os._exit(code)
 
     def _monitor_loop(self):
         while not self._stop.wait(self._poll_s):

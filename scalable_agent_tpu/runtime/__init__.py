@@ -14,6 +14,13 @@ from scalable_agent_tpu.runtime.faults import (
     configure_faults,
     get_fault_injector,
 )
+from scalable_agent_tpu.runtime.fleet import (
+    FleetMonitor,
+    GraceWindow,
+    PeerTracker,
+    configure_fleet,
+    get_fleet,
+)
 from scalable_agent_tpu.runtime.learner import (
     Learner,
     LearnerHyperparams,

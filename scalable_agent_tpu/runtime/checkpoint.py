@@ -48,6 +48,7 @@ from scalable_agent_tpu.obs import (
     get_watchdog,
 )
 from scalable_agent_tpu.runtime.faults import get_fault_injector
+from scalable_agent_tpu.runtime.fleet import get_fleet
 from scalable_agent_tpu.runtime.learner import TrainState
 from scalable_agent_tpu.utils import log
 
@@ -248,8 +249,12 @@ class CheckpointManager:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            decision = bool(multihost_utils.broadcast_one_to_all(
-                np.asarray(decision)))
+            # Fleet-guarded (runtime/fleet.py): a peer lost inside the
+            # decision broadcast or the allgather below is attributed
+            # and bounded (exit 72) instead of hanging every survivor.
+            with get_fleet().collective("ckpt_save_decision"):
+                decision = bool(multihost_utils.broadcast_one_to_all(
+                    np.asarray(decision)))
         if not decision:
             return False
         registry = get_registry()
@@ -260,7 +265,8 @@ class CheckpointManager:
                     "state fetch + orbax write seconds").time():
             # Collective state fetch FIRST (every process participates,
             # nothing here may fail on only one of them)...
-            host_state = jax.tree_util.tree_map(_to_host, state)
+            with get_fleet().collective("ckpt_save_allgather"):
+                host_state = jax.tree_util.tree_map(_to_host, state)
             # ...then the primary-only, fallible IO.
             try:
                 if injector.active:
@@ -422,10 +428,15 @@ class CheckpointManager:
 
         from jax.experimental import multihost_utils
 
+        # Every collective below rides the fleet guard: a peer that
+        # died between init and restore would otherwise hang the whole
+        # fleet at its very first cross-process point.
+        fleet = get_fleet()
         has_any = (bool(self._manager.all_steps())
                    if self._is_primary else False)
-        has_any = bool(multihost_utils.broadcast_one_to_all(
-            np.asarray(has_any)))
+        with fleet.collective("ckpt_restore_has_any"):
+            has_any = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(has_any)))
         if not has_any:
             return None
         if target is None:
@@ -435,10 +446,12 @@ class CheckpointManager:
         # Collective (_to_host allgathers) — only pay it once a
         # checkpoint actually exists; every process reaches it together,
         # BEFORE the primary's fallible walk-back.
-        host_target = jax.tree_util.tree_map(_to_host, target)
+        with fleet.collective("ckpt_restore_allgather"):
+            host_target = jax.tree_util.tree_map(_to_host, target)
         found = self._walk_back(host_target) if self._is_primary else None
-        step = int(multihost_utils.broadcast_one_to_all(
-            np.asarray(-1 if found is None else found[0])))
+        with fleet.collective("ckpt_restore_step_broadcast"):
+            step = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(-1 if found is None else found[0])))
         if step < 0:
             # has_any was True, so a negative step can only mean the
             # primary's walk-back rejected every retained step — raise
@@ -449,7 +462,8 @@ class CheckpointManager:
                 f"from scratch (move or delete the directory to start "
                 f"fresh)")
         restored = found[1] if self._is_primary else host_target
-        restored = multihost_utils.broadcast_one_to_all(restored)
+        with fleet.collective("ckpt_restore_state_broadcast"):
+            restored = multihost_utils.broadcast_one_to_all(restored)
         return step, restored
 
     def latest_verified_step(self) -> Optional[int]:

@@ -1,0 +1,43 @@
+"""One registry for every deliberate non-zero exit the runtime takes.
+
+A production supervisor restarts failed workers by exit code; three
+subsystems ending runs with three privately-defined constants is how
+two of them end up sharing a number.  Every bounded-failure path
+imports its code from here, and docs/robustness.md renders this table
+for operators:
+
+| Code | Name | Raised by | Meaning |
+|---|---|---|---|
+| 70 | watchdog | obs/watchdog.py (``--watchdog_abort``) | a pipeline thread missed its heartbeat deadline — the run was wedged, forensics dumped |
+| 71 | non-finite | driver._rollback_or_exit | the non-finite tolerance was exhausted with ``--no_rollback`` or nothing restorable — numeric divergence, not a hang |
+| 72 | fleet | runtime/fleet.py | a peer process was lost (stale heartbeat, dead coordinator, timed-out collective) or the preemption grace window expired — restart and resume |
+
+``128 + signum`` (e.g. 143 for SIGTERM with the grace protocol
+disabled) keeps its POSIX meaning; 0 is a completed run — including a
+preempted run that drained and checkpointed inside its grace window.
+
+This module must stay import-free (pure constants): it is imported from
+both the obs layer and the runtime layer, and anything heavier would
+recreate the circular-import problem that scattered the codes in the
+first place.
+"""
+
+# EX_SOFTWARE-adjacent block, deliberately contiguous and above the
+# 64-78 sysexits range's common collisions.
+WATCHDOG_EXIT_CODE = 70
+NONFINITE_EXIT_CODE = 71
+FLEET_EXIT_CODE = 72
+
+# name -> (code, one-line operator meaning); the docs table and the
+# exit-code tests render from this.
+EXIT_CODES = {
+    "watchdog": (WATCHDOG_EXIT_CODE,
+                 "a pipeline thread missed its heartbeat deadline "
+                 "(hang; --watchdog_abort)"),
+    "nonfinite": (NONFINITE_EXIT_CODE,
+                  "non-finite tolerance exhausted with --no_rollback "
+                  "or no restorable checkpoint"),
+    "fleet": (FLEET_EXIT_CODE,
+              "peer lost / collective timed out / preemption grace "
+              "expired — restart resumes from the last checkpoint"),
+}

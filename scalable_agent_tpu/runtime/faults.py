@@ -17,6 +17,18 @@ runtime's failure-prone seams —
   integrity manifest + walk-back restore.
 - ``ckpt_save_fail`` (runtime/checkpoint.py): raise inside a cadenced
   save, exercising the log-and-continue degrade path.
+- ``peer_exit``  (runtime/fleet.py): ``os._exit(1)`` from the fleet
+  monitor cycle — sudden peer death; SURVIVORS must detect the stale
+  heartbeat and exit 72.  Occurrences count monitor cycles.
+- ``peer_hang``  (runtime/fleet.py): the heartbeat publisher falls
+  silent forever — a wedged-but-alive peer, same survivor contract.
+- ``preempt_sigterm`` (runtime/fleet.py): the process SIGTERMs itself,
+  driving the preemption-grace protocol (coordinated final checkpoint,
+  clean exit) deterministically.
+
+The three fleet points are armed per-process (each process parses its
+OWN ``--chaos_spec``), so a multi-process soak arms them on exactly one
+peer and asserts the OTHERS' behavior.
 
 The ``--chaos_spec`` grammar is ``point@i[:j:k...]`` entries joined by
 ``;``: each integer is a 1-based *occurrence index* of that injection

@@ -29,6 +29,7 @@ from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from scalable_agent_tpu.models.agent import ImpalaAgent
@@ -267,8 +268,31 @@ class Learner:
 
     def place_state(self, state: TrainState) -> TrainState:
         """Commit a (host or device) TrainState onto the mesh — also the
-        restore path after checkpoint load."""
-        return jax.device_put(state, self.state_shardings(state))
+        restore path after checkpoint load.
+
+        Multi-process placement builds each global array from
+        process-local data (``make_array_from_callback``) instead of
+        ``jax.device_put``: device_put onto a non-addressable sharding
+        runs a hidden per-leaf ``multihost_utils.assert_equal``
+        collective inside jax whose fire-or-skip decision depends on
+        each leaf's commitment state — the one value-dependent
+        collective sequence in the whole setup path, and gloo (the CPU
+        rig's transport) aborts the entire fleet on any cross-process
+        divergence (pair.cc "op.preamble.length <= op.nbytes").  The
+        callers already guarantee process-identical values (init: same
+        seed; restore/rollback: the primary's state arrives by explicit
+        broadcast), so the local build is also strictly cheaper: no
+        params-sized network broadcast per init/restore."""
+        shardings = self.state_shardings(state)
+        if jax.process_count() <= 1:
+            return jax.device_put(state, shardings)
+
+        def _place(x, s):
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, s, lambda idx, _h=host: _h[idx])
+
+        return jax.tree_util.tree_map(_place, state, shardings)
 
     def put_trajectory(self, trajectory: Trajectory) -> Trajectory:
         """Host batch -> device, sharded over the data axis.
@@ -277,9 +301,15 @@ class Learner:
         shard; the global array is assembled from per-process data so
         the data axis spans hosts (DCN) exactly like the reference's
         actors feeding one learner queue over gRPC
-        (reference: experiment.py:531,556-562)."""
+        (reference: experiment.py:531,556-562).  The fleet guard
+        (runtime/fleet.py) bounds + attributes the assembly when a peer
+        is lost under it — disabled/single-process it is one no-op
+        call."""
+        from scalable_agent_tpu.runtime.fleet import get_fleet
+
         with get_tracer().span("learner/put_trajectory", cat="h2d"), \
-                self._h_put.time():
+                self._h_put.time(), \
+                get_fleet().collective("put_trajectory"):
             result = self._transport.put(trajectory)
         get_flight_recorder().record("queue", "put_trajectory")
         return result

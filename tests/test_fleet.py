@@ -1,0 +1,711 @@
+"""Fleet fault domains — tier-1 units (ISSUE 5).
+
+Everything here runs single-process with injected clocks, an in-memory
+KV fake, and a captured fatal hook: heartbeat staleness math, KV-flag
+propagation, grace-window deadline accounting, collective-timeout
+attribution, the exit-code registry, the SIGTERM grace handler, and the
+coordinator-init retry.  The REAL N-process behavior (SIGKILL -> exit
+72, SIGTERM -> coordinated grace checkpoint -> frame-exact resume) is
+tests/test_fleet_multiproc.py, markers ``multiproc`` + ``slow``.
+"""
+
+import signal
+import threading
+import time
+
+import pytest
+
+from scalable_agent_tpu.obs import MetricsRegistry, get_registry
+from scalable_agent_tpu.runtime import exit_codes
+from scalable_agent_tpu.runtime.faults import configure_faults
+from scalable_agent_tpu.runtime import fleet
+from scalable_agent_tpu.runtime.fleet import (
+    FleetMonitor,
+    GraceWindow,
+    PeerTracker,
+    configure_fleet,
+    get_fleet,
+    install_preemption_handler,
+)
+
+
+class FakeKV:
+    """In-memory stand-in for the jax.distributed KV client (same three
+    methods the fleet layer uses).  ``fail_with`` simulates a dead
+    coordinator: every call raises."""
+
+    def __init__(self):
+        self.store = {}
+        self.fail_with = None
+
+    def _maybe_fail(self):
+        if self.fail_with is not None:
+            raise self.fail_with
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self._maybe_fail()
+        self.store[key] = value
+
+    def key_value_dir_get(self, prefix):
+        self._maybe_fail()
+        return [(k, v) for k, v in self.store.items()
+                if k.startswith(prefix)]
+
+
+class Clock:
+    def __init__(self, start=100.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+def make_monitor(clock, kv, proc=0, n=3, timeout=5.0, grace=0.0,
+                 collective=0.0, registry=None, fatals=None):
+    """An UNSTARTED monitor (tests drive publish_once/monitor_once by
+    hand) whose fatal hook records instead of os._exit-ing."""
+    fatals = fatals if fatals is not None else []
+    monitor = FleetMonitor(
+        peer_timeout_s=timeout, preemption_grace_s=grace,
+        collective_timeout_s=collective,
+        registry=registry or MetricsRegistry(),
+        process_index=proc, num_processes=n, kv=kv, clock=clock,
+        on_fatal=fatals.append, host_exit_linger_s=0.0)
+    monitor._test_fatals = fatals
+    return monitor
+
+
+# ---------------------------------------------------------------------------
+# PeerTracker: pure staleness math
+
+
+class TestPeerTracker:
+    def test_never_published_peer_goes_stale_from_start(self):
+        tracker = PeerTracker([1, 2], start_time=10.0)
+        assert tracker.stale_peers(12.0, 5.0) == []
+        stale = tracker.stale_peers(15.5, 5.0)
+        assert sorted(p for p, _ in stale) == [1, 2]
+        assert all(abs(age - 5.5) < 1e-9 for _, age in stale)
+
+    def test_advancing_seq_resets_staleness(self):
+        tracker = PeerTracker([1], start_time=0.0)
+        tracker.note(1, 7, 4.0)
+        assert tracker.stale_peers(8.9, 5.0) == []
+        tracker.note(1, 8, 9.0)
+        assert tracker.stale_peers(13.9, 5.0) == []
+
+    def test_stuck_seq_is_stale_despite_fresh_reads(self):
+        tracker = PeerTracker([1], start_time=0.0)
+        tracker.note(1, 7, 1.0)
+        # The KV read succeeds every poll, but the VALUE never moves —
+        # remote wall time must play no part.
+        for t in (2.0, 4.0, 6.0, 6.5):
+            tracker.note(1, 7, t)
+        assert tracker.stale_peers(6.5, 5.0) == [(1, 5.5)]
+
+    def test_most_stale_first_and_alive_count(self):
+        tracker = PeerTracker([1, 2, 3], start_time=0.0)
+        tracker.note(1, 1, 9.0)
+        tracker.note(2, 1, 3.0)
+        stale = tracker.stale_peers(10.0, 5.0)
+        assert [p for p, _ in stale] == [3, 2]
+        assert tracker.alive_count(10.0, 5.0) == 1
+
+    def test_unknown_peer_tracked_from_first_sight(self):
+        tracker = PeerTracker([1], start_time=0.0)
+        tracker.note(9, 1, 50.0)
+        assert tracker.stale_peers(54.0, 5.0) == [(1, 54.0)]
+        assert tracker.last_seq(9) == 1
+
+
+# ---------------------------------------------------------------------------
+# GraceWindow: deadline accounting with a mocked clock
+
+
+class TestGraceWindow:
+    def test_closed_window_never_expires(self):
+        clock = Clock(0.0)
+        grace = GraceWindow(10.0, clock=clock)
+        clock.now = 1e9
+        assert not grace.expired()
+        assert grace.remaining() == float("inf")
+
+    def test_open_is_idempotent_and_anchors_first_observation(self):
+        clock = Clock(0.0)
+        grace = GraceWindow(10.0, clock=clock)
+        assert grace.open("signal:SIGTERM")
+        clock.now = 6.0
+        # Re-observing through a second channel (KV flag, broadcast)
+        # must NOT extend the deadline.
+        assert not grace.open("peer:0")
+        assert grace.reason == "signal:SIGTERM"
+        assert abs(grace.remaining() - 4.0) < 1e-9
+        clock.now = 10.0 + 1e-6
+        assert grace.expired()
+        assert grace.remaining() == 0.0
+
+    def test_exact_boundary_is_not_expired(self):
+        clock = Clock(5.0)
+        grace = GraceWindow(2.0, clock=clock)
+        grace.open("r")
+        clock.now = 7.0
+        assert not grace.expired()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats + peer loss
+
+
+class TestHeartbeats:
+    def test_publish_and_alive_gauge(self):
+        clock, kv = Clock(), FakeKV()
+        registry = MetricsRegistry()
+        mons = [make_monitor(clock, kv, proc=i, n=2,
+                             registry=registry if i == 0 else None)
+                for i in range(2)]
+        for monitor in mons:
+            monitor.publish_once()
+        assert kv.store["fleet/hb/0"] == "1"
+        assert kv.store["fleet/hb/1"] == "1"
+        mons[0].monitor_once()
+        assert registry.gauge("fleet/peers_alive").value == 2.0
+        assert not mons[0]._test_fatals
+
+    def test_silent_peer_fatals_72_with_attribution(self):
+        clock, kv = Clock(), FakeKV()
+        registry = MetricsRegistry()
+        alpha = make_monitor(clock, kv, proc=0, n=2, registry=registry)
+        beta = make_monitor(clock, kv, proc=1, n=2)
+        for _ in range(3):
+            alpha.publish_once()
+            beta.publish_once()
+            clock.now += 1.0
+            alpha.monitor_once()
+        assert not alpha._test_fatals
+        # beta falls silent: its sequence stops advancing.
+        for _ in range(6):
+            alpha.publish_once()
+            clock.now += 1.0
+            alpha.monitor_once()
+        assert alpha._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+        assert registry.counter("fleet/peer_lost_total").value == 1.0
+        # One fatal only — a second pass must not re-fire.
+        alpha.monitor_once()
+        assert alpha._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+    def test_starved_own_publisher_defers_peer_verdict(self):
+        # Host-wide CPU crunch (a fleet-wide first compile, a paused
+        # VM): OUR publisher missed its schedule too, so silent peers
+        # are indistinguishable from our own starvation — no fatal
+        # until the local heartbeat plane recovers, and none at all
+        # when the peers' advance was merely unobserved.
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=0, n=2)
+        beta = make_monitor(clock, kv, proc=1, n=2)
+        alpha.publish_once()
+        beta.publish_once()
+        clock.now += 1.0
+        alpha.monitor_once()
+        assert not alpha._test_fatals
+        # 8s global stall: nobody published, nobody polled.  Beta looks
+        # 9s silent, but alpha's own publish is just as old -> defer.
+        clock.now += 8.0
+        alpha.monitor_once()
+        assert not alpha._test_fatals
+        # Both planes recover; beta advanced -> verdict clears for good.
+        beta.publish_once()
+        alpha.publish_once()
+        clock.now += 1.0
+        alpha.monitor_once()
+        assert not alpha._test_fatals
+
+    def test_truly_dead_peer_still_fatals_after_recovery(self):
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=0, n=2)
+        beta = make_monitor(clock, kv, proc=1, n=2)
+        alpha.publish_once()
+        beta.publish_once()
+        clock.now += 1.0
+        alpha.monitor_once()
+        # beta dies inside the 8s stall; alpha defers while starved...
+        clock.now += 8.0
+        alpha.monitor_once()
+        assert not alpha._test_fatals
+        # ...then alpha recovers, beta stays silent past the deadline:
+        # the deferred verdict fires.
+        for _ in range(6):
+            alpha.publish_once()
+            clock.now += 1.0
+            alpha.monitor_once()
+        assert alpha._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+    def test_kv_unreachable_fatals_after_deadline(self):
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=1, n=2)
+        alpha.publish_once()
+        alpha.monitor_once()
+        kv.fail_with = ConnectionError("coordinator gone")
+        clock.now += 1.0
+        alpha.monitor_once()  # first failure: deadline starts
+        assert not alpha._test_fatals
+        clock.now += 5.5  # past peer_timeout_s=5
+        alpha.monitor_once()
+        assert alpha._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+    def test_timeout_zero_disables_kv_unreachable_verdict(self):
+        # config.py: peer_timeout_s=0 DISABLES detection.  A transient
+        # KV blip must not fatal a fleet that opted out (the check
+        # 'down > 0s' would otherwise fire on the second failed poll).
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=1, n=2, timeout=0.0)
+        kv.fail_with = ConnectionError("coordinator gone")
+        for _ in range(3):
+            clock.now += 10.0
+            alpha.monitor_once()
+        assert not alpha._test_fatals
+
+    def test_host_linger_covers_a_peer_dump_path(self):
+        # The service-hosting process must exit LAST on a fatal (jax's
+        # client SIGABRTs peers the instant the service dies).  A
+        # peer's exit path is its verdict (up to ~2 polls after ours)
+        # plus its forensic dump, bounded by the _DUMP_JOIN_S join —
+        # NOT just heartbeat phase skew: under load the peer's dump
+        # blocks up to _DUMP_BLOCK_S on the lock an unwinding
+        # exception's dump holds (the reason_pin race).
+        clock, kv = Clock(), FakeKV()
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            process_index=0, num_processes=2, kv=kv, clock=clock,
+            on_fatal=lambda code: None)
+        assert monitor._host_linger_s == pytest.approx(
+            fleet._DUMP_JOIN_S + 2.0 * monitor._poll_s + 1.0)
+
+    def test_kv_recovery_resets_the_deadline(self):
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=0, n=2)
+        beta = make_monitor(clock, kv, proc=1, n=2)
+        kv.fail_with = ConnectionError("blip")
+        alpha.monitor_once()
+        clock.now += 4.0
+        kv.fail_with = None
+        beta.publish_once()
+        alpha.monitor_once()
+        clock.now += 4.0  # would be past the deadline had it not reset
+        beta.publish_once()
+        alpha.monitor_once()
+        assert not alpha._test_fatals
+
+
+# ---------------------------------------------------------------------------
+# KV preemption-flag propagation
+
+
+class TestPreemptFlag:
+    def test_flag_propagates_via_kv(self):
+        clock, kv = Clock(), FakeKV()
+        alpha = make_monitor(clock, kv, proc=0, n=2, grace=30.0)
+        beta = make_monitor(clock, kv, proc=1, n=2, grace=30.0)
+        beta.request_preemption("signal:SIGTERM")
+        assert beta.preemption_requested()
+        assert not alpha.preemption_requested()
+        beta.publish_once()  # the push rides the publisher, not gRPC
+        # The flag lives UNDER the heartbeat prefix so the monitor's
+        # single per-poll dir-get serves both reads.
+        assert kv.store["fleet/hb/preempt"] == "1:signal:SIGTERM"
+        alpha.publish_once()
+        alpha.monitor_once()
+        assert alpha.preemption_requested()
+        # Observation anchored ALPHA's grace window too.
+        assert alpha._grace.opened and "peer:1" in alpha._grace.reason
+
+    def test_local_request_defers_counter_to_monitor_thread(self):
+        # The signal handler path must take no instrument/logging locks
+        # (request_preemption), so the counter lands on the next
+        # monitor pass.
+        clock, kv = Clock(), FakeKV()
+        registry = MetricsRegistry()
+        monitor = make_monitor(clock, kv, n=1, grace=30.0,
+                               registry=registry)
+        monitor.request_preemption("signal:SIGTERM")
+        counter = registry.counter("fleet/preemptions_total")
+        assert counter.value == 0.0
+        monitor.monitor_once()
+        assert counter.value == 1.0
+
+    def test_grace_expiry_fatals_72(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=1, grace=10.0)
+        monitor.request_preemption("signal:SIGTERM")
+        clock.now += 9.0
+        monitor.monitor_once()
+        assert not monitor._test_fatals
+        clock.now += 1.5
+        monitor.monitor_once()
+        assert monitor._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+
+# ---------------------------------------------------------------------------
+# Collective-timeout guard
+
+
+class TestCollectiveGuard:
+    def test_overdue_collective_fatals_with_name(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=2, collective=20.0)
+        with monitor.collective("ckpt_save_allgather"):
+            clock.now += 21.0
+            assert monitor.in_flight_collectives() == [
+                ("ckpt_save_allgather", 21.0)]
+            monitor.monitor_once()
+        assert monitor._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+    def test_completed_collective_disarms(self):
+        clock, kv = Clock(), FakeKV()
+        # Huge peer timeout: this test is about the guard alone, the
+        # never-published peers must not trip the heartbeat path.
+        monitor = make_monitor(clock, kv, n=2, timeout=1e6,
+                               collective=20.0)
+        with monitor.collective("decision_broadcast"):
+            pass
+        clock.now += 100.0
+        monitor.monitor_once()
+        assert not monitor._test_fatals
+        assert monitor.in_flight_collectives() == []
+
+    def test_single_process_arms_nothing(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=1, collective=20.0)
+        with monitor.collective("put_trajectory"):
+            assert monitor.in_flight_collectives() == []
+
+    def test_explicit_timeout_overrides_default(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=2, collective=1000.0)
+        with monitor.collective("fast_barrier", timeout_s=2.0):
+            clock.now += 3.0
+            monitor.monitor_once()
+        assert monitor._test_fatals == [exit_codes.FLEET_EXIT_CODE]
+
+    def test_auto_default_sits_above_compile_scale(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=2, timeout=60.0)
+        assert monitor.collective_timeout_s == 600.0
+        monitor2 = make_monitor(clock, kv, n=2, timeout=300.0)
+        assert monitor2.collective_timeout_s == 1200.0
+
+
+# ---------------------------------------------------------------------------
+# Chaos points
+
+
+class TestFleetChaos:
+    def test_peer_hang_silences_the_publisher(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, proc=0, n=2)
+        monitor.publish_once()
+        assert kv.store["fleet/hb/0"] == "1"
+        configure_faults("peer_hang@1")
+        try:
+            monitor.monitor_once()  # chaos rides the monitor cycle
+            monitor.publish_once()
+            monitor.publish_once()
+            assert kv.store["fleet/hb/0"] == "1"  # frozen forever
+        finally:
+            configure_faults("")
+
+    def test_fleet_points_parse(self):
+        from scalable_agent_tpu.runtime.faults import parse_chaos_spec
+
+        spec = parse_chaos_spec(
+            "peer_exit@3;peer_hang@1;preempt_sigterm@5")
+        assert spec == {"peer_exit": frozenset({3}),
+                        "peer_hang": frozenset({1}),
+                        "preempt_sigterm": frozenset({5})}
+
+
+# ---------------------------------------------------------------------------
+# Exit-code registry
+
+
+class TestExitCodes:
+    def test_registry_is_consistent_and_distinct(self):
+        codes = [code for code, _ in exit_codes.EXIT_CODES.values()]
+        assert len(codes) == len(set(codes))
+        assert exit_codes.EXIT_CODES["watchdog"][0] == \
+            exit_codes.WATCHDOG_EXIT_CODE == 70
+        assert exit_codes.EXIT_CODES["nonfinite"][0] == \
+            exit_codes.NONFINITE_EXIT_CODE == 71
+        assert exit_codes.EXIT_CODES["fleet"][0] == \
+            exit_codes.FLEET_EXIT_CODE == 72
+
+    def test_driver_and_watchdog_import_the_registry(self):
+        from scalable_agent_tpu import driver
+        from scalable_agent_tpu.obs import watchdog
+
+        assert driver.NONFINITE_EXIT_CODE is exit_codes.NONFINITE_EXIT_CODE
+        assert watchdog._abort_exit_code() == exit_codes.WATCHDOG_EXIT_CODE
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM handler: first = grace, second = escalate, uninstall = clean
+
+
+class TestPreemptionHandler:
+    def test_first_sets_flag_second_chains_to_previous(self):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=1, grace=30.0)
+        calls = []
+
+        def sentinel(signum, frame):
+            calls.append(signum)
+
+        old = signal.signal(signal.SIGTERM, sentinel)
+        try:
+            uninstall = install_preemption_handler(monitor)
+            handler = signal.getsignal(signal.SIGTERM)
+            assert handler is not sentinel
+            handler(signal.SIGTERM, None)
+            assert monitor.preemption_requested()
+            assert calls == []
+            handler(signal.SIGTERM, None)  # operator wants out NOW
+            assert calls == [signal.SIGTERM]
+            uninstall()
+            assert signal.getsignal(signal.SIGTERM) is sentinel
+        finally:
+            signal.signal(signal.SIGTERM, old)
+
+    def test_uninstall_is_identity_checked(self):
+        # The obs teardown restores ITS saved handler over the fleet's
+        # before the fleet stops; the fleet's later uninstall must then
+        # no-op rather than resurrect a dead layer's handler.
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(clock, kv, n=1, grace=30.0)
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            uninstall = install_preemption_handler(monitor)
+
+            def replacement(signum, frame):
+                pass
+
+            signal.signal(signal.SIGTERM, replacement)
+            uninstall()
+            assert signal.getsignal(signal.SIGTERM) is replacement
+        finally:
+            signal.signal(signal.SIGTERM, old)
+
+
+# ---------------------------------------------------------------------------
+# configure_fleet lifecycle
+
+
+class TestConfigureFleet:
+    def test_disabled_by_default_and_after_teardown(self):
+        fleet = get_fleet()
+        assert not fleet.enabled
+        assert not fleet.preemption_requested()
+        with fleet.collective("anything"):
+            pass
+
+    def test_single_process_without_grace_stays_disabled(self):
+        fleet = configure_fleet(60.0, preemption_grace_s=0.0,
+                                process_index=0, num_processes=1,
+                                registry=MetricsRegistry())
+        try:
+            assert not fleet.enabled
+        finally:
+            configure_fleet(None)
+
+    def test_grace_enables_even_single_process(self):
+        fleet = configure_fleet(
+            60.0, preemption_grace_s=30.0, process_index=0,
+            num_processes=1, registry=MetricsRegistry(), kv=FakeKV())
+        try:
+            assert fleet.enabled
+            assert get_fleet() is fleet
+            # The monitor thread is live; the publisher is not (no
+            # peers to heartbeat).
+            names = {t.name for t in threading.enumerate()}
+            assert "fleet-monitor" in names
+            assert "fleet-publish" not in names
+        finally:
+            configure_fleet(None)
+            assert not get_fleet().enabled
+
+    def test_multiprocess_starts_publisher(self):
+        fleet = configure_fleet(
+            5.0, preemption_grace_s=0.0, process_index=0,
+            num_processes=2, registry=MetricsRegistry(), kv=FakeKV(),
+            on_fatal=lambda code: None)
+        try:
+            assert fleet.enabled
+            names = {t.name for t in threading.enumerate()}
+            assert "fleet-publish" in names and "fleet-monitor" in names
+        finally:
+            configure_fleet(None)
+
+
+# ---------------------------------------------------------------------------
+# initialize_distributed: bounded coordinator retry
+
+
+class TestInitRetry:
+    @pytest.fixture()
+    def fake_time(self, monkeypatch):
+        from scalable_agent_tpu.parallel import distributed
+
+        t = [1000.0]
+        monkeypatch.setattr(distributed.time, "monotonic",
+                            lambda: t[0])
+        monkeypatch.setattr(
+            distributed.time, "sleep",
+            lambda s: t.__setitem__(0, t[0] + s))
+        # The mocked initialize never stands up a distributed client,
+        # so actually switching CPU collectives to gloo would poison
+        # this process's backend init.
+        monkeypatch.setattr(distributed, "_enable_cpu_gloo_collectives",
+                            lambda: (lambda: None))
+        return t
+
+    def test_retries_until_coordinator_up(self, monkeypatch, fake_time):
+        from scalable_agent_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        attempts = []
+
+        def flaky_init(**kwargs):
+            attempts.append(kwargs)
+            if len(attempts) < 3:
+                raise RuntimeError("UNAVAILABLE: connection refused")
+
+        import jax
+
+        monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+        before = get_registry().counter("fleet/init_retries_total").value
+        initialize_distributed("localhost:1", 2, 1, init_timeout_s=60.0)
+        assert len(attempts) == 3
+        after = get_registry().counter("fleet/init_retries_total").value
+        assert after - before == 2.0
+        # Capped exponential backoff: 0.5 then 1.0.
+        assert fake_time[0] == pytest.approx(1001.5)
+
+    def test_gives_up_at_the_deadline(self, monkeypatch, fake_time):
+        from scalable_agent_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        def always_down(**kwargs):
+            raise RuntimeError("UNAVAILABLE: connection refused")
+
+        import jax
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_down)
+        with pytest.raises(RuntimeError) as excinfo:
+            initialize_distributed("localhost:1", 2, 1,
+                                   init_timeout_s=5.0)
+        assert "coordinator_init_timeout_s" in str(excinfo.value)
+        assert "localhost:1" in str(excinfo.value)
+
+    def test_no_config_is_untouched(self, monkeypatch):
+        from scalable_agent_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        import jax
+
+        def boom(**kwargs):  # must never be called
+            raise AssertionError("initialize called without config")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert initialize_distributed() is False
+
+
+# ---------------------------------------------------------------------------
+# Fleet gauge fold in the multi-process aggregator
+
+
+class TestFleetFold:
+    def test_peers_alive_folds_min(self):
+        from scalable_agent_tpu.obs.aggregate import aggregate_prometheus
+
+        texts = {
+            "0": ("# TYPE impala_fleet_peers_alive gauge\n"
+                  "impala_fleet_peers_alive 3.0\n"),
+            "1": ("# TYPE impala_fleet_peers_alive gauge\n"
+                  "impala_fleet_peers_alive 2.0\n"),
+        }
+        merged = aggregate_prometheus(texts)
+        assert ('impala_fleet_peers_alive{fold="min"} 2.0'
+                in merged)
+
+    def test_peer_lost_total_still_sums(self):
+        from scalable_agent_tpu.obs.aggregate import aggregate_prometheus
+
+        texts = {
+            "0": ("# TYPE impala_fleet_peer_lost_total counter\n"
+                  "impala_fleet_peer_lost_total 1.0\n"),
+            "1": ("# TYPE impala_fleet_peer_lost_total counter\n"
+                  "impala_fleet_peer_lost_total 1.0\n"),
+        }
+        merged = aggregate_prometheus(texts)
+        assert ('impala_fleet_peer_lost_total{fold="sum"} 2.0'
+                in merged)
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder attribution on a fatal
+
+
+class TestFatalForensics:
+    def test_fatal_records_events_and_in_flight_collectives(self):
+        from scalable_agent_tpu.obs import FlightRecorder
+
+        clock, kv = Clock(), FakeKV()
+        recorder = FlightRecorder(capacity=1024)
+        fatals = []
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            recorder=recorder, process_index=0, num_processes=2,
+            kv=kv, clock=clock, on_fatal=fatals.append,
+            host_exit_linger_s=0.0)
+        with monitor.collective("retire_update"):
+            clock.now += 6.0
+            monitor.publish_once()  # own plane healthy: verdict allowed
+            monitor.monitor_once()  # peer 1 never published -> lost
+        assert fatals == [exit_codes.FLEET_EXIT_CODE]
+        events = recorder.snapshot()
+        kinds = {e["kind"] for e in events}
+        assert "peer_lost" in kinds and "fleet_fatal" in kinds
+        (fatal,) = [e for e in events if e["kind"] == "fleet_fatal"]
+        assert fatal["name"] == "peer_lost"
+        assert fatal["args"]["in_flight_collectives"] == {
+            "retire_update": 6.0}
+
+    def test_fatal_reason_survives_later_symptom_dump(self, tmp_path):
+        """The aborted collective's XlaRuntimeError unwinds AFTER the
+        fleet verdict and re-dumps: the verdict's pinned reason must
+        stay on the file, the symptom demoted to secondary_reason."""
+        import json
+
+        from scalable_agent_tpu.obs import FlightRecorder
+
+        clock, kv = Clock(), FakeKV()
+        recorder = FlightRecorder(capacity=1024, logdir=str(tmp_path))
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            recorder=recorder, process_index=0, num_processes=2,
+            kv=kv, clock=clock, on_fatal=lambda code: None,
+            host_exit_linger_s=0.0)
+        clock.now += 6.0
+        monitor.publish_once()
+        monitor.monitor_once()  # peer 1 lost -> fatal dump, reason pinned
+        # The symptom cascade: gloo's abort raises in the main thread
+        # and its exception hook re-dumps with a generic reason.
+        recorder.dump_all("exception:XlaRuntimeError")
+        payload = json.load(open(recorder.dump_path()))
+        assert payload["reason"] == "fleet:peer_lost"
+        assert payload["secondary_reason"] == "exception:XlaRuntimeError"
+        assert recorder.last_dump_reason == "fleet:peer_lost"

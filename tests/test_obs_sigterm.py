@@ -1,7 +1,12 @@
 """ISSUE 2 acceptance: SIGTERM to a traced driver run leaves a loadable
 ``flightrec.<pid>.json`` (plus the stack dump and a final metrics
 snapshot) — the signal path through driver._setup_observability's crash
-handlers, exercised against the REAL driver in a subprocess."""
+handlers, exercised against the REAL driver in a subprocess.
+
+``--preemption_grace_s=0`` pins the LEGACY dump-and-exit(143) contract
+this test owns; with the grace protocol enabled (the default since the
+fleet layer, runtime/fleet.py) SIGTERM instead drains to a final
+checkpoint and exits 0 — covered by tests/test_fleet_multiproc.py."""
 
 import glob
 import json
@@ -26,7 +31,8 @@ def test_sigterm_to_traced_driver_leaves_flight_recorder(tmp_path):
          "--num_action_repeats=1", "--total_environment_frames=1000000",
          "--height=16", "--width=16", "--num_env_workers_per_group=2",
          "--compute_dtype=float32", "--checkpoint_interval_s=1e9",
-         "--log_interval_s=0.2", "--trace=true", "--seed=3"],
+         "--log_interval_s=0.2", "--trace=true", "--seed=3",
+         "--preemption_grace_s=0"],
         env=env, cwd=os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
