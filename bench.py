@@ -1407,6 +1407,136 @@ def bench_fleet(diag):
             (per_update_s + thread_s_per_update) / sec_per_update, 6)
 
 
+def bench_elastic(diag, budget_s=150.0):
+    """Elastic membership stage (ISSUE 6).  Two numbers:
+
+    (a) ``elastic_watch_cycle_us`` / ``_overhead_frac_on_update`` —
+    the supervisor's steady-state watch cycle (poll N workers + the
+    MTTR beacon stat + the rejoin probe) timed against fakes and
+    amortized at its real poll cadence.  The supervisor runs in its
+    own process, so this is the whole recurring cost of being
+    supervised on a shared host.
+
+    (b) ``elastic_mttr_s`` — a REAL mini reshard: a 2-process CPU
+    fleet under ``python -m scalable_agent_tpu.runtime.elastic`` loses
+    one worker to SIGKILL; the supervisor relaunches the survivor as a
+    1-process fleet and reports kill -> first post-reshard metrics row
+    from its own ``fleet_epochs.jsonl``.  Workers are pinned to CPU
+    (a TPU bench host cannot share its chips between concurrent
+    worker processes), so the number is rig-relative — the guard
+    treats it as advisory everywhere; the binding acceptance lives in
+    tests/test_elastic_multiproc.py."""
+    import shutil
+    import signal as signal_lib
+    import tempfile
+
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.runtime.elastic import ElasticSupervisor
+
+    class _IdleWorker:
+        def poll(self):
+            return None
+
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        registry = MetricsRegistry()
+        supervisor = ElasticSupervisor(
+            3, tmp, launcher=None, registry=registry)
+        workers = [_IdleWorker() for _ in range(3)]
+        n = 5000
+
+        def per_cycle_us(anchor):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                supervisor.watch_cycle(workers, 0, anchor)
+            return (time.perf_counter() - t0) / n * 1e6
+
+        cycle_us = per_cycle_us(None)
+        diag["elastic_watch_cycle_us"] = round(cycle_us, 3)
+        # Recovery-window cycles additionally stat the MTTR beacon
+        # file; reported separately, not part of steady state.
+        diag["elastic_watch_cycle_mttr_us"] = round(
+            per_cycle_us(time.monotonic()), 3)
+        poll_hz = 1.0 / supervisor._poll_s
+        diag["elastic_supervisor_overhead_frac_on_update"] = round(
+            poll_hz * cycle_us / 1e6, 9)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- (b) the real mini reshard ------------------------------------
+    logdir = tempfile.mkdtemp(prefix="bench_elastic_soak_")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    args = [
+        sys.executable, "-m", "scalable_agent_tpu.runtime.elastic",
+        "--mode=train", "--level_name=fake_small", "--logdir", logdir,
+        "--num_actors=2", "--batch_size=4", "--unroll_length=3",
+        "--num_action_repeats=1", "--height=16", "--width=16",
+        "--num_env_workers_per_group=1", "--compute_dtype=float32",
+        "--log_interval_s=0.2", "--checkpoint_interval_s=1.0",
+        "--peer_timeout_s=6", "--preemption_grace_s=30",
+        "--total_environment_frames=1000000",
+        "--distributed_num_processes=2",
+        "--elastic_rejoin_delay_s=1000000",
+    ]
+    deadline = time.monotonic() + budget_s
+    epochs_path = os.path.join(logdir, "fleet_epochs.jsonl")
+
+    def epoch_events():
+        try:
+            return [json.loads(line) for line in
+                    open(epochs_path).read().splitlines() if line]
+        except (OSError, json.JSONDecodeError):
+            return []
+
+    supervisor_proc = subprocess.Popen(
+        args, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        pids = None
+        while time.monotonic() < deadline and pids is None:
+            launches = [e for e in epoch_events()
+                        if e.get("event") == "launch"]
+            if launches:
+                pids = launches[0]["pids"]
+            time.sleep(0.5)
+        ckpt_dir = os.path.join(logdir, "checkpoints")
+        while time.monotonic() < deadline and not any(
+                name.isdigit() for name in (
+                    os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir)
+                    else [])):
+            time.sleep(0.5)
+        if pids is None or time.monotonic() >= deadline:
+            diag.setdefault("warnings", []).append(
+                "bench_elastic: mini fleet produced no checkpoint "
+                "inside the budget; MTTR not measured")
+            return
+        os.kill(pids[1], signal_lib.SIGKILL)
+        mttr = None
+        while time.monotonic() < deadline and mttr is None:
+            mttrs = [e for e in epoch_events()
+                     if e.get("event") == "mttr"]
+            if mttrs:
+                mttr = float(mttrs[0]["mttr_s"])
+            time.sleep(0.5)
+        if mttr is None:
+            diag.setdefault("warnings", []).append(
+                "bench_elastic: no MTTR record inside the budget "
+                "(reshard did not complete)")
+        else:
+            diag["elastic_mttr_s"] = round(mttr, 3)
+    finally:
+        if supervisor_proc.poll() is None:
+            supervisor_proc.terminate()
+            try:
+                supervisor_proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                supervisor_proc.kill()
+                supervisor_proc.wait(timeout=30)
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
 # The finite check's budget on the update stage (ISSUE 4 acceptance).
 RESILIENCE_BUDGET_FRAC = 0.01
 
@@ -1470,6 +1600,47 @@ def fleet_regression_guard(diag):
                 "sec_per_update makes the ratio jitter-bound")
         else:
             diag["errors"].append(msg)
+
+
+# The supervisor's steady-state budget (ISSUE 6 acceptance): its watch
+# cycle amortized at the poll cadence must stay under 0.5% of wall
+# time (= of the update stage when the device is saturated).
+ELASTIC_BUDGET_FRAC = 0.005
+# Advisory MTTR ceiling for the CPU mini-soak: peer_timeout (6s) +
+# forensic dump + backoff + jax.distributed re-init + restore + the
+# relaunched fleet's FIRST COMPILE — which dominates on CPU (~60-90s
+# measured on the reference rig, putting healthy runs at ~95s); beyond
+# this ceiling something regressed in the recovery path.
+ELASTIC_MTTR_ADVISORY_S = 150.0
+
+
+def elastic_regression_guard(diag):
+    """ISSUE 6 acceptance: fail the bench when the elastic
+    supervisor's steady-state overhead exceeds 0.5% of the update
+    stage (binding on TPU, advisory on the CPU fallback — same
+    platform discipline as the fleet guard).  The measured MTTR is
+    advisory on every platform: the mini-soak's workers always run on
+    CPU, so its absolute number is rig-relative."""
+    frac = diag.get("elastic_supervisor_overhead_frac_on_update")
+    if frac is None:
+        return  # stage never ran (its own error already recorded)
+    if frac > ELASTIC_BUDGET_FRAC:
+        msg = (
+            f"ELASTIC: supervisor watch-cycle overhead {frac:.3%} "
+            f"exceeds the {ELASTIC_BUDGET_FRAC:.1%} budget "
+            f"(cycle {diag.get('elastic_watch_cycle_us')}us)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory")
+        else:
+            diag["errors"].append(msg)
+    mttr = diag.get("elastic_mttr_s")
+    if mttr is not None and mttr > ELASTIC_MTTR_ADVISORY_S:
+        diag.setdefault("warnings", []).append(
+            f"elastic: reshard MTTR {mttr:.1f}s exceeds the "
+            f"{ELASTIC_MTTR_ADVISORY_S:.0f}s advisory ceiling — the "
+            f"recovery path (detection, backoff, re-init, restore) "
+            f"likely regressed")
 
 
 def transport_regression_guard(diag, bench_dir=None):
@@ -1904,6 +2075,17 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_fleet failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "bench_elastic"
+    try:
+        # The mini-reshard's workers always run on CPU (a TPU bench
+        # host can't share its chips between concurrent processes), so
+        # the budget is CPU-sized everywhere: epoch 0's first compile
+        # to a durable checkpoint (~60-90s) + the relaunched fleet's
+        # recovery (~95s measured) must BOTH fit.
+        bench_elastic(diag, budget_s=300.0)
+    except Exception:
+        diag["errors"].append(
+            "bench_elastic failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "e2e_link_retry"
     try:
         maybe_retry_e2e(diag, start_monotonic, deadline)
@@ -1943,6 +2125,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "fleet regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "elastic_regression_guard"
+    try:
+        elastic_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "elastic regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "done"
     emit()

@@ -224,6 +224,35 @@ class Config:
     # startup retries for this long before failing the run
     # (fleet/init_retries_total counts the attempts).
     coordinator_init_timeout_s: float = 60.0
+    # -- elastic fleet membership (runtime/elastic.py) -------------------
+    # Supervisor mode: instead of training directly, own
+    # distributed_num_processes (or 1) worker processes, watch their
+    # exit codes, and convert a fleet-fatal (exit 72) or preemption
+    # into a RESHARD event — relaunch the survivors as an (N-1)-process
+    # fleet resuming from the newest verified checkpoint — then scale
+    # back to N when the lost slot rejoins.  Equivalent CLI:
+    # python -m scalable_agent_tpu.runtime.elastic <same flags>.
+    elastic: bool = False
+    # Membership epoch this worker belongs to (set by the supervisor on
+    # every (re)launch; surfaces as the fleet/epoch gauge and in the
+    # fleet_epoch.json membership verdict).  Operators never set it.
+    fleet_epoch: int = 0
+    # Reshard-restart budget: consecutive fleet relaunches (capped
+    # exponential backoff between them) before the supervisor gives up
+    # and exits with the workers' code.  The counter resets once an
+    # epoch survives elastic_stable_s.
+    elastic_restart_budget: int = 8
+    # Seconds a fleet must run before its epoch counts as stable
+    # (resets the restart budget and the backoff).
+    elastic_stable_s: float = 300.0
+    # Seconds after a slot is LOST (worker SIGKILLed / host gone)
+    # before the supervisor may schedule its rejoin; an operator can
+    # force an earlier rejoin by touching <logdir>/rejoin.<slot>.
+    # The scale-up itself happens at the next checkpoint boundary: the
+    # running fleet is drained through the preemption-grace protocol
+    # (one coordinated verified checkpoint, exit 0) and relaunched at
+    # the larger size.
+    elastic_rejoin_delay_s: float = 60.0
 
     # -------------------------------------------------------------------
 
@@ -261,6 +290,48 @@ class Config:
             raw = json.load(f)
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def from_argv(cls, argv=None, description=None) -> "Config":
+        """Parse a full CLI flag set (one ``--<field>`` per dataclass
+        field) into a Config — the ONE parser shared by the driver and
+        the elastic supervisor entry points, so their flag surfaces can
+        never drift.  ``description`` is what ``--help`` prints above
+        the option list (the driver passes its module docstring — the
+        curated flag reference)."""
+        import argparse
+
+        parser = argparse.ArgumentParser(
+            description=description,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+        for field in dataclasses.fields(cls):
+            arg_type = type(field.default)
+            if arg_type is bool:
+                parser.add_argument(
+                    f"--{field.name}", type=lambda v: v.lower() in
+                    ("1", "true", "yes"), default=field.default)
+            else:
+                parser.add_argument(
+                    f"--{field.name}", type=arg_type,
+                    default=field.default)
+        return cls(**vars(parser.parse_args(argv)))
+
+    def to_argv(self, exclude: Tuple[str, ...] = ()) -> list:
+        """The inverse of ``from_argv``: the minimal ``--field=value``
+        list reproducing this config (non-default fields only, minus
+        ``exclude``) — how the elastic supervisor hands its own config
+        to the worker processes it spawns."""
+        args = []
+        for field in dataclasses.fields(self):
+            if field.name in exclude:
+                continue
+            value = getattr(self, field.name)
+            if value == field.default:
+                continue
+            if isinstance(value, bool):
+                value = "true" if value else "false"
+            args.append(f"--{field.name}={value}")
+        return args
 
     @classmethod
     def from_checkpoint_dir(cls, logdir: str, **overrides) -> "Config":

@@ -46,13 +46,24 @@ Fleet fault-domain flags (runtime/fleet.py, docs/robustness.md):
     --collective_timeout_s=C  deadline on each blocking cross-process
         point (0 = auto); --coordinator_init_timeout_s bounds the
         initialize retry loop.
+
+Elastic membership flags (runtime/elastic.py, docs/robustness.md):
+    --elastic                 supervisor mode: own N worker processes,
+        convert a fleet-fatal (exit 72) or preemption into a RESHARD —
+        relaunch the survivors as an (N-1)-process fleet resuming from
+        the newest verified checkpoint — and scale back to N when the
+        lost slot rejoins (graceful drain at a checkpoint boundary).
+        Equivalent: python -m scalable_agent_tpu.runtime.elastic.
+    --elastic_restart_budget / --elastic_stable_s   consecutive-restart
+        cap with capped backoff; the budget resets once an epoch stays
+        up elastic_stable_s.
+    --elastic_rejoin_delay_s  how long a lost slot stays out before it
+        may rejoin (touch <logdir>/rejoin.<slot> to force it early).
 """
 
-import argparse
 import dataclasses
 import functools
 import json
-import math
 import os
 import queue as queue_lib
 import threading
@@ -144,13 +155,17 @@ def resolve_mesh_data(config: Config) -> int:
                 f"seq={config.mesh_seq}, model={config.mesh_model}) "
                 f"must cover all {n_devices} global devices")
         return mesh_data
-    # The batch axis shards over ('data', 'seq'): pick the largest
-    # data-axis size such that data*seq divides the batch (a 4-batch
-    # debug run on an 8-device mesh uses 4 of them rather than
-    # failing), out of the devices left after seq/model take theirs.
-    return config.mesh_data or math.gcd(
-        max(1, config.batch_size // config.mesh_seq),
-        max(1, n_devices // non_data))
+    # Single process: the shared auto-sizing rule (parallel/mesh.py) —
+    # the largest data axis such that data*seq divides the batch, out
+    # of the devices left after seq/model take theirs.  Elastic
+    # restarts lean on this: a fleet relaunched with a different
+    # process/device count resizes its mesh here with no operator
+    # input.
+    from scalable_agent_tpu.parallel.mesh import auto_data_axis
+
+    return config.mesh_data or auto_data_axis(
+        config.batch_size, n_devices, seq=config.mesh_seq,
+        model=config.mesh_model)
 
 
 def resolve_core_impl(config: Config) -> str:
@@ -642,7 +657,9 @@ def train(config: Config) -> Dict[str, float]:
         preemption_grace_s=config.preemption_grace_s,
         collective_timeout_s=config.collective_timeout_s,
         registry=registry,
-        recorder=get_flight_recorder())
+        recorder=get_flight_recorder(),
+        epoch=config.fleet_epoch,
+        logdir=config.logdir)
     pool = prefetch_thread = writer = ckpt = None
     prefetch_stop = threading.Event()
     profiling = False
@@ -686,6 +703,15 @@ def train(config: Config) -> Dict[str, float]:
             state = learner.place_state(host_state)
             if cpu_lockstep:
                 jax.block_until_ready(state)
+            # Topology-agnostic resume (runtime/elastic.py): when this
+            # fleet's process/device layout differs from the one that
+            # wrote the checkpoint (an elastic reshard), the placed
+            # state is gathered back and re-verified against the
+            # per-leaf CRC manifest — collective, so every process
+            # reaches it together (restore() guarantees `restored` is
+            # non-None on all of them together).
+            ckpt.verify_after_reshard(start_updates, state)
+            fleet.note_checkpoint(start_updates)
             log.info("restored checkpoint at update %d (%.0f frames)",
                      start_updates, _host_scalar(state.env_frames))
         else:
@@ -985,7 +1011,11 @@ def train(config: Config) -> Dict[str, float]:
                 frames_at_last_log = frames
                 interval.clear()
                 continue
-            ckpt.maybe_save(updates, state)
+            if ckpt.maybe_save(updates, state):
+                # The membership verdict (fleet_epoch.json) names the
+                # newest resumable step — the elastic supervisor's
+                # answer to "where will the resharded fleet resume".
+                fleet.note_checkpoint(updates)
         # Disarm before the shutdown tail (final forced checkpoint,
         # pool joins, writer close): a slow-but-healthy shutdown must
         # not read as a stalled_thread wedge — and must never be
@@ -996,9 +1026,24 @@ def train(config: Config) -> Dict[str, float]:
         drained = inflight.drain()
         if drained is not None:
             metrics = drained
-        ckpt.maybe_save(updates, state, force=True)
+        if ckpt.maybe_save(updates, state, force=True):
+            fleet.note_checkpoint(updates)
         completed = True
     finally:
+        # Membership verdict FIRST: an exception unwinding a
+        # multi-process run is usually a peer's death arriving as an
+        # aborted collective, and jax's own client fatal (SIGABRT) can
+        # end this process anywhere in the teardown below — the
+        # elastic supervisor's epoch-stamped verdict must already be
+        # on disk by then (fleet.note_fatal_error no-ops on clean
+        # exits, single-process runs, and when the monitor's richer
+        # verdict already landed).
+        import sys as _sys
+
+        _exc = _sys.exc_info()[1]
+        if _exc is not None and not isinstance(
+                _exc, (SystemExit, KeyboardInterrupt)):
+            fleet.note_fatal_error(_exc)
         # Disarm the watchdog for the WHOLE teardown tail — the
         # exception path skips the loop-exit suspend above, and pool
         # joins/writer/ckpt closes must never be os._exit(70)'d by a
@@ -1158,6 +1203,10 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     if restored is not None:
         start_updates, host_state = restored
         state = learner.place_state(host_state)
+        # Same topology-agnostic resume contract as the host backend
+        # (single-process here, so a reshard means a device-count
+        # change — e.g. a debug resume of an 8-device run on 1).
+        ckpt.verify_after_reshard(start_updates, state)
         log.info("restored checkpoint at update %d (%.0f frames); the "
                  "device env rollout restarts from fresh episodes (like "
                  "the host pipeline's env processes)",
@@ -1184,7 +1233,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         preemption_grace_s=config.preemption_grace_s,
         collective_timeout_s=config.collective_timeout_s,
         registry=registry,
-        recorder=get_flight_recorder())
+        recorder=get_flight_recorder(),
+        epoch=config.fleet_epoch,
+        logdir=config.logdir)
+    if restored is not None:
+        fleet.note_checkpoint(start_updates)
     watchdog = get_watchdog()
     nonfinite = NonFiniteTracker(config.nonfinite_tolerance,
                                  registry=registry)
@@ -1248,12 +1301,22 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         "(%.3g frames) for the final checkpoint",
                         updates, frames)
                     break
-                ckpt.maybe_save(updates, state)
+                if ckpt.maybe_save(updates, state):
+                    fleet.note_checkpoint(updates)
             # Same shutdown-tail disarm as the host backend: the final
             # forced save must not trip (or be aborted by) the watchdog.
             watchdog.suspend("learner")
-            ckpt.maybe_save(updates, state, force=True)
+            if ckpt.maybe_save(updates, state, force=True):
+                fleet.note_checkpoint(updates)
     finally:
+        # Same verdict-first contract as train(): the membership
+        # verdict must beat any teardown abort (no-op single-process).
+        import sys as _sys
+
+        _exc = _sys.exc_info()[1]
+        if _exc is not None and not isinstance(
+                _exc, (SystemExit, KeyboardInterrupt)):
+            fleet.note_fatal_error(_exc)
         configure_watchdog(None)  # same teardown-tail disarm as train()
         configure_faults("")
         ckpt.close()
@@ -1514,19 +1577,22 @@ def main(argv: Optional[Sequence[str]] = None):
     # setting JAX_PLATFORMS=cpu must get CPU, not a hung remote claim).
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    parser = argparse.ArgumentParser(description=__doc__)
-    for field in dataclasses.fields(Config):
-        arg_type = type(field.default)
-        if arg_type is bool:
-            parser.add_argument(
-                f"--{field.name}", type=lambda v: v.lower() in
-                ("1", "true", "yes"), default=field.default)
-        else:
-            parser.add_argument(
-                f"--{field.name}", type=arg_type, default=field.default)
-    args = parser.parse_args(argv)
-    config = Config(**vars(args))
+    config = Config.from_argv(argv, description=__doc__)
     if config.mode == "train":
+        if config.elastic:
+            # Elastic supervisor mode (runtime/elastic.py): this
+            # process owns N worker fleets across membership epochs
+            # instead of training itself — it must never initialize a
+            # jax backend (on TPU that would lock the chips its
+            # workers need).
+            from scalable_agent_tpu.runtime.elastic import (
+                run_supervised,
+            )
+
+            code = run_supervised(config)
+            if code:
+                raise SystemExit(code)
+            return
         train(config)
     elif config.mode == "test":
         test(config)

@@ -223,6 +223,13 @@ def _fleet_fold(family: str, metric: str, kind: str,
         return "sum"
     if "peers_alive" in metric:
         return "min"
+    # Elastic membership (runtime/elastic.py): the epoch gauge is a
+    # fleet-wide cursor — mid-relaunch, a straggler's stale snapshot
+    # still shows the OLD epoch, and summing epochs is meaningless;
+    # the newest (max) epoch is the membership truth.  MTTR likewise
+    # reports the worst (max) observed recovery.
+    if "fleet_epoch" in metric or metric.endswith("fleet_mttr_s"):
+        return "max"
     # Occupancy BEFORE the quantile rule: the runtime's occupancy
     # instruments are histograms (quantile-labelled summaries), and the
     # fleet question is "who is most starved" — min — for every series
@@ -299,6 +306,12 @@ def find_artifacts(logdir: str) -> Tuple[List[str], Dict[str, str]]:
     for path in sorted(glob.glob(os.path.join(logdir, "metrics*.prom"))):
         name = os.path.basename(path)
         if name == FLEET_PROM_NAME:
+            continue
+        if name == "metrics.supervisor.prom":
+            # The elastic supervisor's own snapshot
+            # (runtime/elastic.py): folded alongside the workers under
+            # a human-readable process label.
+            proms["supervisor"] = path
             continue
         match = re.match(r"metrics\.p(\d+)\.prom$", name)
         proms["0" if name == "metrics.prom"

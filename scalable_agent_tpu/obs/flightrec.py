@@ -359,6 +359,33 @@ def install_crash_handlers(recorder: Optional[FlightRecorder] = None,
 
     threading.excepthook = _thread_hook
 
+    # SIGABRT forensics (ISSUE 6): jax's C++ coordination client
+    # LOG(FATAL)s (abort, signal 6/exit 134) from a gRPC thread when
+    # the coordinator dies under it — abort() never runs Python, so
+    # neither the ring dump nor the signal-handler path above can fire.
+    # ``faulthandler``'s C-level handler CAN: it synchronously writes
+    # every thread's stack to a pre-opened file as the process dies
+    # (the ring-dump side of that fault is covered by the fleet
+    # monitor's early ``kv_suspect`` dump, runtime/fleet.py).
+    # ``faulthandler.register`` refuses the fatal signals, so this is
+    # ``enable()`` — covering SIGSEGV/SIGBUS/SIGILL/SIGFPE too, which
+    # is strictly more forensics — guarded so an ALREADY-enabled
+    # faulthandler (pytest's plugin, an operator's
+    # PYTHONFAULTHANDLER=1) is never hijacked away from its stream.
+    # The file is pre-opened because a dying process must not
+    # allocate; an empty one is deleted at uninstall so clean runs
+    # leave no litter.
+    abort_file = None
+    if rec.logdir is not None and not faulthandler.is_enabled():
+        abort_path = os.path.join(
+            rec.logdir, f"stacks.sigabrt.{os.getpid()}.txt")
+        try:
+            os.makedirs(rec.logdir, exist_ok=True)
+            abort_file = open(abort_path, "w")
+            faulthandler.enable(file=abort_file, all_threads=True)
+        except (OSError, ValueError, RuntimeError):
+            abort_file = None
+
     def uninstall():
         for sig, prev in prev_signal.items():
             try:
@@ -367,5 +394,17 @@ def install_crash_handlers(recorder: Optional[FlightRecorder] = None,
                 pass
         sys.excepthook = prev_sys_hook
         threading.excepthook = prev_thread_hook
+        if abort_file is not None:
+            try:
+                faulthandler.disable()
+            except (OSError, ValueError, RuntimeError):
+                pass
+            try:
+                empty = abort_file.tell() == 0
+                abort_file.close()
+                if empty:
+                    os.remove(abort_file.name)
+            except OSError:
+                pass
 
     return uninstall

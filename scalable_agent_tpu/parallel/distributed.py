@@ -19,12 +19,25 @@ multi-host save/restore of global arrays).
 """
 
 import os
+import socket
 import time
 from typing import Optional
 
 import jax
 
 from scalable_agent_tpu.utils import log
+
+
+def pick_unused_port(host: str = "localhost") -> int:
+    """An OS-assigned free TCP port — the coordinator-port allocator
+    for launchers that stand fleets up on one machine (the elastic
+    supervisor, the multi-process test harness).  The usual bind(0)
+    race applies: the port is only *probably* free by the time the
+    coordinator binds it, which is why ``initialize_distributed``'s
+    retry loop — not this helper — owns robustness."""
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
 
 # Backoff shape for the coordinator-connect retry: first retry after
 # 0.5s, doubling to a 10s cap — a fleet scheduler routinely starts

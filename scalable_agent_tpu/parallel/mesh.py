@@ -17,6 +17,7 @@ data-parallel gradient traffic then rides ICI within a slice and DCN
 across slices, chosen by XLA from the device topology.
 """
 
+import math
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -36,6 +37,24 @@ class MeshSpec(NamedTuple):
     data: int
     seq: int = 1
     model: int = 1
+
+
+def auto_data_axis(batch_size: int, num_devices: int,
+                   seq: int = 1, model: int = 1) -> int:
+    """The largest data-axis size a single-process mesh can take: the
+    batch shards over (data x seq), so ``data * seq`` must divide the
+    batch, out of the devices left after seq/model take theirs (a
+    4-batch debug run on an 8-device host uses 4 devices rather than
+    failing).  Pure math, shared by the driver's mesh sizing and every
+    "auto" kernel-choice estimate — and the reason an ELASTIC restart
+    at a different device count resizes its mesh without operator
+    input: the same batch re-shards over whatever devices the new
+    membership epoch has (tests/test_elastic.py pins the adaptation
+    table)."""
+    non_data = seq * model
+    return math.gcd(
+        max(1, batch_size // seq),
+        max(1, num_devices // non_data))
 
 
 def make_mesh(spec: Optional[MeshSpec] = None,

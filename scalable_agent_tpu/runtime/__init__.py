@@ -14,6 +14,12 @@ from scalable_agent_tpu.runtime.faults import (
     configure_faults,
     get_fault_injector,
 )
+from scalable_agent_tpu.runtime.elastic import (
+    DriverLauncher,
+    ElasticSupervisor,
+    classify_exit,
+    run_supervised,
+)
 from scalable_agent_tpu.runtime.fleet import (
     FleetMonitor,
     GraceWindow,

@@ -80,6 +80,13 @@ def _to_host(x):
     return np.asarray(x)
 
 
+def _current_topology() -> dict:
+    """The process/device layout of THIS run — stamped into manifests
+    so a resumed run can tell it resharded."""
+    return {"num_processes": int(jax.process_count()),
+            "num_devices": int(jax.device_count())}
+
+
 def _leaf_checksums(host_state) -> List[dict]:
     """Per-leaf (shape, dtype, crc32) in flatten order — the integrity
     manifest's body.  Flatten order is deterministic for a fixed
@@ -153,11 +160,20 @@ class CheckpointManager:
 
     def _write_manifest(self, step: int, host_state) -> None:
         """Atomic (tmp + rename) per-leaf checksum manifest for one
-        saved step, and prune manifests of rotated-out steps."""
+        saved step, and prune manifests of rotated-out steps.
+
+        The manifest is computed over the HOST-GATHERED (fully
+        replicated/global) state, so it is topology-agnostic by
+        construction: the same bytes describe the checkpoint whether it
+        is later restored onto 1 process or N — ``topology`` records
+        the writing layout purely so a resumed run can DETECT a
+        reshard and re-verify after placement
+        (``verify_after_reshard``)."""
         os.makedirs(self._manifest_dir, exist_ok=True)
         payload = {
             "schema_version": _MANIFEST_SCHEMA,
             "step": step,
+            "topology": _current_topology(),
             "leaves": _leaf_checksums(host_state),
         }
         path = self._manifest_path(step)
@@ -465,6 +481,88 @@ class CheckpointManager:
         with fleet.collective("ckpt_restore_state_broadcast"):
             restored = multihost_utils.broadcast_one_to_all(restored)
         return step, restored
+
+    def saved_topology(self, step: int) -> Optional[dict]:
+        """The ``{"num_processes", "num_devices"}`` layout that wrote
+        ``step``'s manifest; None for legacy/absent manifests.  A disk
+        read — in multi-process runs only the primary's answer is
+        authoritative (``verify_after_reshard`` broadcasts the
+        decision)."""
+        try:
+            manifest = json.load(open(self._manifest_path(step)))
+        except (OSError, json.JSONDecodeError):
+            return None
+        return manifest.get("topology")
+
+    def verify_after_reshard(self, step: int, placed_state,
+                             force: bool = False) -> bool:
+        """Re-verify per-leaf CRCs AFTER a restored state was committed
+        onto THIS run's mesh, iff the checkpoint was written by a
+        DIFFERENT process/device layout (elastic reshard, ISSUE 6).
+
+        The on-disk format is host-gathered and fully replicated, so a
+        reshard is value-preserving by construction — this check proves
+        it held end-to-end (restore broadcast + ``place_state``
+        resharding included) by gathering the PLACED state back to host
+        and comparing it against the step's manifest.  Topology
+        unchanged (or unknown/legacy manifest) is a no-op returning
+        False; a verified reshard returns True; a mismatch raises
+        ``CheckpointIntegrityError`` on every process.
+
+        Collective in multi-process runs (the gather allgathers and the
+        decision/verdict are broadcast) — every process must call it
+        together, which the driver's restore path guarantees.
+        ``force=True`` verifies regardless of the recorded topology
+        (same value on every process) — the audit knob, and how the
+        single-process reshard tests exercise the machinery on a rig
+        whose global device count never changes."""
+        current = _current_topology()
+        fleet = get_fleet()
+        saved = None
+        why = ""
+        if jax.process_count() <= 1:
+            saved = self.saved_topology(step)
+            if not (force or (saved and saved != current)):
+                return False
+            ok, why = self._verify(
+                step, jax.tree_util.tree_map(_to_host, placed_state))
+        else:
+            from jax.experimental import multihost_utils
+
+            if self._is_primary:
+                saved = self.saved_topology(step)
+            resharded = force or (bool(saved) and saved != current)
+            with fleet.collective("ckpt_reshard_decision"):
+                resharded = bool(multihost_utils.broadcast_one_to_all(
+                    np.asarray(resharded)))
+            if not resharded:
+                return False
+            with fleet.collective("ckpt_reshard_allgather"):
+                host_state = jax.tree_util.tree_map(
+                    _to_host, placed_state)
+            ok = True
+            if self._is_primary:
+                ok, why = self._verify(step, host_state)
+            with fleet.collective("ckpt_reshard_verdict"):
+                ok = bool(multihost_utils.broadcast_one_to_all(
+                    np.asarray(ok)))
+        if not ok:
+            raise CheckpointIntegrityError(
+                f"checkpoint step {step} failed per-leaf CRC "
+                f"verification after resharding onto {current} "
+                f"(saved at {saved}): {why or 'see the primary log'}")
+        get_registry().counter(
+            "checkpoint/reshard_verifications_total",
+            "restores that crossed a process/device-layout change and "
+            "re-verified their manifest after resharding").inc()
+        get_flight_recorder().record(
+            "ckpt_reshard", str(step),
+            {"saved": saved, "current": current})
+        log.info(
+            "checkpoint step %d restored across a topology change "
+            "(%s -> %s); per-leaf CRCs re-verified after reshard",
+            step, saved, current)
+        return True
 
     def latest_verified_step(self) -> Optional[int]:
         """The newest retained step (no verification — cheap metadata

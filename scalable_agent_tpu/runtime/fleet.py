@@ -48,13 +48,40 @@ publisher falls silent forever — a wedged-but-alive peer), and
 ``preempt_sigterm`` (the process SIGTERMs itself — deterministic
 preemption).  Occurrence indices count monitor cycles.
 
-Known bound on this jax/jaxlib: if the COORDINATOR process is
-SIGKILL'd, peers may die on jax's own client fatal (SIGABRT 134 from
-the failed error-poll RPC) before the ``kv_unreachable`` deadline can
-convert it to 72 — there is no Python hook to intercept that abort.
-The kv_unreachable path still owns the host-alive-but-service-wedged
-shape, and exit-72 ordering is arranged so OUR fatals never trigger
-the abort: the service-hosting process lingers and exits last.
+Known bound on this jax/jaxlib, now MITIGATED (ISSUE 6): if the
+COORDINATOR process is SIGKILL'd, peers die on jax's own client
+fatal (SIGABRT 134) before the ``kv_unreachable`` deadline can
+convert it to 72 — the client's ``PollForError`` long-poll notices
+the closed socket in ~2s, faster than any KV-poll cadence, and there
+is no Python hook to run ring-dump code inside ``abort()`` (injecting
+``missed_heartbeat_callback`` fails with ``std::bad_cast`` on this
+jaxlib).  Two layers make the path forensic anyway: (1) the crash
+handlers (obs/flightrec.py) enable the C-level ``faulthandler`` on
+the fatal signals, which synchronously writes every thread's stack to
+``stacks.sigabrt.<pid>.txt`` as the process dies — the GUARANTEED
+artifact on the abort path; (2) the monitor's first failed KV poll
+fires an early ``kv_suspect`` ring dump on a helper thread, covering
+the shapes where the KV plane degrades WITHOUT a client fatal (a
+wedged-but-alive coordinator, a partitioned KV service) and any rig
+where the abort loses the race.  Exit 134 (signal 6) is documented in
+docs/robustness.md; a supervisor treats it like 72 (restart and
+resume).  The kv_unreachable path still owns the
+host-alive-but-service-wedged shape, and exit-72 ordering is arranged
+so OUR fatals never trigger the abort: the service-hosting process
+lingers and exits last.
+
+Elastic membership (ISSUE 6, runtime/elastic.py): every fatal verdict,
+preemption decision, and exception unwinding the training loop
+(``note_fatal_error`` — the driver's finally calls it first, before
+any teardown step jax's client fatal could abort) also lands a
+machine-readable membership verdict at ``<logdir>/fleet_epoch.json``
+(epoch, kind, lost peers, last verified checkpoint step) — the
+artifact the elastic supervisor consumes to decide between a reshard
+relaunch, a rejoin scale-up, and "the run actually finished".  The driver feeds
+``note_checkpoint(step)`` after every verified save so the verdict
+names the newest resumable step, and the ``fleet/epoch`` gauge puts
+the membership epoch on the metrics plane (obs/aggregate.py folds it
+max across processes).
 
 Everything here is testable without a real fleet: ``PeerTracker`` and
 ``GraceWindow`` are pure deadline math over injected timestamps, and
@@ -66,6 +93,7 @@ method lookups, the same discipline as the watchdog.
 
 import contextlib
 import itertools
+import json
 import os
 import signal
 import threading
@@ -78,6 +106,7 @@ from scalable_agent_tpu.runtime.faults import get_fault_injector
 from scalable_agent_tpu.utils import log
 
 __all__ = [
+    "EPOCH_VERDICT_NAME",
     "FleetMonitor",
     "GraceWindow",
     "PeerTracker",
@@ -98,6 +127,9 @@ _PREEMPT_KEY = _HB_PREFIX + _PREEMPT_LEAF
 # is joined for at most _DUMP_JOIN_S before the process exits.
 _DUMP_BLOCK_S = 10.0
 _DUMP_JOIN_S = 15.0
+# Membership verdict the elastic supervisor consumes (ISSUE 6).
+EPOCH_VERDICT_NAME = "fleet_epoch.json"
+_EPOCH_VERDICT_SCHEMA = 1
 
 
 def _kv_client():
@@ -225,7 +257,9 @@ class FleetMonitor:
                  on_fatal: Optional[Callable[[int], None]] = None,
                  publish_interval_s: Optional[float] = None,
                  poll_interval_s: Optional[float] = None,
-                 host_exit_linger_s: Optional[float] = None):
+                 host_exit_linger_s: Optional[float] = None,
+                 epoch: int = 0,
+                 logdir: Optional[str] = None):
         if process_index is None or num_processes is None:
             import jax
 
@@ -268,6 +302,20 @@ class FleetMonitor:
         registry.gauge(
             "fleet/peer_timeout_s",
             "configured peer heartbeat deadline").set(self.peer_timeout_s)
+        # Elastic membership (runtime/elastic.py): the epoch this
+        # process was launched into, and where the membership verdict
+        # file lands.  The supervisor bumps the epoch on every
+        # relaunch, so the aggregated (fold=max) gauge IS the fleet's
+        # membership-history cursor.
+        self.epoch = int(epoch)
+        self._logdir = logdir
+        self._last_verified_step = -1
+        registry.gauge(
+            "fleet/epoch",
+            "elastic membership epoch this process was launched into "
+            "(bumped by the supervisor on every reshard/rejoin "
+            "relaunch)").set(float(self.epoch))
+        self._kv_suspect_dumped = False
 
         beat = self.peer_timeout_s if self.peer_timeout_s > 0 else 4.0
         self._publish_s = publish_interval_s or max(0.2, min(2.0, beat / 5))
@@ -340,6 +388,38 @@ class FleetMonitor:
             with self._coll_lock:
                 self._collectives.pop(token, None)
 
+    def note_checkpoint(self, step: int):
+        """The driver landed (or restored) a VERIFIED checkpoint at
+        ``step`` — remember it so a later membership verdict names the
+        newest resumable step.  One int store; called at checkpoint
+        cadence, not per update."""
+        self._last_verified_step = max(self._last_verified_step,
+                                       int(step))
+
+    def note_fatal_error(self, error: BaseException):
+        """An exception is unwinding the training loop.  In a
+        multi-process fleet that is usually someone ELSE's death
+        arriving early: the aborted collective's XlaRuntimeError (gloo
+        fails fast on a reset connection) can beat the heartbeat
+        deadline, and jax's own client fatal (SIGABRT) can then end
+        the process mid-teardown — before the monitor ever judges the
+        peer.  Land the membership verdict NOW (kind
+        ``collective_error``), so the elastic supervisor always finds
+        an epoch-stamped verdict no matter which exit path wins; the
+        monitor's own fatal (richer — it names the stale peer) keeps
+        precedence when it got there first, and may still overwrite
+        this one later (last writer wins, both epoch-stamped)."""
+        if self.num_processes <= 1 or self._fatal_fired:
+            return
+        detail = {"error_type": type(error).__name__,
+                  "error": str(error)[:200]}
+        self._recorder.record(
+            "fleet_error", type(error).__name__,
+            dict(detail,
+                 in_flight_collectives=dict(
+                     self.in_flight_collectives())))
+        self._write_epoch_verdict("collective_error", detail)
+
     def in_flight_collectives(self) -> List[Tuple[str, float]]:
         """[(name, age_s)] of currently-armed collectives — the fatal
         dump's attribution payload."""
@@ -392,6 +472,13 @@ class FleetMonitor:
             "preempt", "decision",
             {"update": int(update),
              "remaining_s": round(self._grace.remaining(), 3)})
+        # A drained preemption exits 0 on EVERY process — exactly like
+        # a completed run.  The verdict file is how the elastic
+        # supervisor tells them apart (epoch-stamped, so a stale file
+        # from a previous epoch can't read as this one's preemption).
+        self._write_epoch_verdict(
+            "preempt", {"update": int(update),
+                        "reason": self._preempt_reason or "decision"})
         log.warning(
             "fleet: coordinated preemption drain at update %d "
             "(%.1fs of grace left)", update, self._grace.remaining())
@@ -489,6 +576,23 @@ class FleetMonitor:
                     log.warning("fleet: KV store unreachable (%s) — "
                                 "coordinator suspect, deadline %.0fs",
                                 exc, self.peer_timeout_s)
+                if not self._kv_suspect_dumped:
+                    # Early forensics (once per run): a dead
+                    # coordinator can SIGABRT this process through
+                    # jax's own client fatal BEFORE the kv_unreachable
+                    # deadline converts it to a bounded 72 — abort()
+                    # runs no Python, so the ring dump must already be
+                    # on disk by then.  Fire-and-forget helper thread:
+                    # this is a suspicion, not a verdict, and the
+                    # monitor pass must not block on the dump lock.
+                    self._kv_suspect_dumped = True
+                    self._recorder.record(
+                        "fleet_suspect", "kv_unreachable",
+                        {"error": str(exc)[:200]})
+                    threading.Thread(
+                        target=self._recorder.dump_all,
+                        args=("fleet:kv_suspect",),
+                        daemon=True, name="flightrec-dump").start()
                 # Same opt-out as stale-peer detection: peer_timeout_s=0
                 # disables the verdict (config.py), not "fatal on the
                 # second failed poll".
@@ -586,6 +690,40 @@ class FleetMonitor:
             except Exception:  # must never die silently
                 log.exception("fleet monitor pass failed")
 
+    # -- membership verdict (elastic supervisor contract) ------------------
+
+    def _write_epoch_verdict(self, kind: str, detail: dict,
+                             lost_peers: Optional[
+                                 List[Tuple[int, float]]] = None):
+        """Atomic ``<logdir>/fleet_epoch.json``: the machine-readable
+        membership verdict the elastic supervisor consumes.  Every
+        process writes the same epoch/kind (last writer wins — the
+        tmp+rename keeps the file always-parseable); ``lost_peers`` and
+        ``last_verified_step`` tell the supervisor who to drop and
+        where resume will land."""
+        if not self._logdir:
+            return
+        payload = {
+            "schema_version": _EPOCH_VERDICT_SCHEMA,
+            "epoch": self.epoch,
+            "kind": kind,
+            "process_index": self.process_index,
+            "num_processes": self.num_processes,
+            "lost_peers": [int(p) for p, _ in (lost_peers or [])],
+            "last_verified_step": self._last_verified_step,
+            "detail": detail,
+            "wrote_unix": time.time(),
+        }
+        path = os.path.join(self._logdir, EPOCH_VERDICT_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            log.exception(
+                "fleet: could not write membership verdict %s", path)
+
     # -- fatal path --------------------------------------------------------
 
     def _fatal(self, kind: str, detail: dict,
@@ -608,6 +746,10 @@ class FleetMonitor:
         self._recorder.record(
             "fleet_fatal", kind,
             dict(detail, in_flight_collectives=dict(in_flight)))
+        # Membership verdict BEFORE the dump: the supervisor's reshard
+        # decision must never wait on (or lose a race with) the
+        # forensic dump budget.
+        self._write_epoch_verdict(kind, detail, lost_peers=lost_peers)
         log.error(
             "fleet: %s %s — in-flight collectives: %s — dumping "
             "forensics and exiting %d (restart resumes from the last "
@@ -688,6 +830,12 @@ class _DisabledFleet:
         pass
 
     def note_preempt_decision(self, update: int):
+        pass
+
+    def note_checkpoint(self, step: int):
+        pass
+
+    def note_fatal_error(self, error: BaseException):
         pass
 
     def in_flight_collectives(self):

@@ -352,3 +352,48 @@ class TestResilienceRegressionGuard:
         bench.resilience_regression_guard(diag)
         assert diag["errors"] == []
         assert any("skipped update" in w for w in diag["warnings"])
+
+
+class TestElasticRegressionGuard:
+    """ISSUE 6 satellite: the elastic supervisor's steady-state budget
+    guard (<0.5% of the update stage) fails on TPU, warns on the CPU
+    fallback, and treats the CPU mini-soak's MTTR as advisory."""
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "elastic_watch_cycle_us": 20.0}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self):
+        diag = self._diag(
+            elastic_supervisor_overhead_frac_on_update=0.02)
+        bench.elastic_regression_guard(diag)
+        assert any("ELASTIC" in e for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self):
+        diag = self._diag(
+            platform="cpu",
+            elastic_supervisor_overhead_frac_on_update=0.02)
+        bench.elastic_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("ELASTIC" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self):
+        diag = self._diag(
+            elastic_supervisor_overhead_frac_on_update=0.0001)
+        bench.elastic_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.elastic_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_slow_mttr_is_advisory_on_every_platform(self):
+        diag = self._diag(
+            elastic_supervisor_overhead_frac_on_update=0.0001,
+            elastic_mttr_s=500.0)
+        bench.elastic_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("MTTR" in w for w in diag["warnings"])

@@ -1,0 +1,159 @@
+"""Static guard: every blocking cross-process call site stays fleet-guarded.
+
+PR 5 hand-wrapped each blocking collective (decision broadcasts, Orbax
+allgathers, the exit barrier) in ``fleet.collective(...)`` so a lost
+peer converts an infinite hang into an attributed exit 72.  That
+completeness was enforced by review — this test enforces it by
+CONSTRUCTION as the code grows: it walks the ASTs of
+``scalable_agent_tpu/runtime/`` and ``driver.py`` and fails when a
+call to a blocking cross-process primitive is not lexically inside a
+``with ...collective(...)`` block.
+
+Sites that are guarded BY THEIR CALLERS (a helper whose every call
+site wraps it) must be listed in ``ALLOWLIST`` with a justification —
+and stale allowlist entries fail too, so the list can only shrink.
+"""
+
+import ast
+import os
+
+import scalable_agent_tpu
+
+PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
+
+# The blocking cross-process primitives: each call BLOCKS until every
+# process arrives (or, for the KV wait, until a remote write lands) —
+# exactly the calls a dead peer turns into an infinite hang.
+BLOCKING_CALLS = {
+    "broadcast_one_to_all",
+    "process_allgather",
+    "sync_global_devices",
+    "assert_equal",
+    "make_array_from_process_local_data",
+    "key_value_get",       # the blocking KV wait (not set/dir_get)
+    "wait_at_barrier",
+}
+
+# (path relative to the package dir, innermost enclosing function):
+# sites whose guard lives at the CALLER.  Every entry must still match
+# a real site — a stale entry fails the test.
+ALLOWLIST = {
+    # Gathers one leaf to host; every caller (maybe_save's
+    # ckpt_save_allgather, restore's ckpt_restore_allgather,
+    # verify_after_reshard's ckpt_reshard_allgather) wraps the WHOLE
+    # tree_map in a fleet.collective.
+    ("runtime/checkpoint.py", "_to_host"),
+    # Per-leaf / packed trajectory assembly; guarded by
+    # Learner.put_trajectory's collective("put_trajectory") around the
+    # transport.put call.
+    ("runtime/transport.py", "build"),
+    ("runtime/transport.py", "upload"),
+}
+
+
+def _lint_file(path):
+    """[(lineno, call_name, innermost_function, guarded)] for every
+    blocking call site in one file."""
+    tree = ast.parse(open(path).read(), filename=path)
+    sites = []
+
+    def is_collective_with(node):
+        for item in node.items:
+            expr = item.context_expr
+            if (isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr == "collective"):
+                return True
+        return False
+
+    def call_name(node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+        return None
+
+    def visit(node, func_stack, guarded):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_stack = func_stack + [node.name]
+        if isinstance(node, ast.With) and is_collective_with(node):
+            guarded = True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in BLOCKING_CALLS:
+                sites.append((node.lineno, name,
+                              func_stack[-1] if func_stack else "<module>",
+                              guarded))
+        for child in ast.iter_child_nodes(node):
+            visit(child, func_stack, guarded)
+
+    visit(tree, [], False)
+    return sites
+
+
+def collect_sites():
+    files = [os.path.join(PKG_DIR, "driver.py")]
+    runtime_dir = os.path.join(PKG_DIR, "runtime")
+    files += sorted(
+        os.path.join(runtime_dir, name)
+        for name in os.listdir(runtime_dir) if name.endswith(".py"))
+    found = {}
+    for path in files:
+        rel = os.path.relpath(path, PKG_DIR)
+        for lineno, name, func, guarded in _lint_file(path):
+            found.setdefault(rel, []).append(
+                (lineno, name, func, guarded))
+    return found
+
+
+def test_every_blocking_call_site_is_fleet_guarded():
+    found = collect_sites()
+    offenders = []
+    matched_allowlist = set()
+    for rel, sites in found.items():
+        for lineno, name, func, guarded in sites:
+            if guarded:
+                continue
+            key = (rel, func)
+            if key in ALLOWLIST:
+                matched_allowlist.add(key)
+                continue
+            offenders.append(
+                f"{rel}:{lineno} `{name}` in {func}() is not inside "
+                f"`with fleet.collective(...)`")
+    assert not offenders, (
+        "unguarded blocking cross-process call sites (wrap them in "
+        "fleet.collective(...) so a lost peer exits 72 instead of "
+        "hanging, or allowlist them with a caller-guard "
+        "justification):\n" + "\n".join(offenders))
+
+
+def test_allowlist_has_no_stale_entries():
+    found = collect_sites()
+    live = set()
+    for rel, sites in found.items():
+        for lineno, name, func, guarded in sites:
+            if not guarded:
+                live.add((rel, func))
+    stale = ALLOWLIST - live
+    assert not stale, (
+        f"ALLOWLIST entries no longer match any unguarded site "
+        f"(delete them): {sorted(stale)}")
+
+
+def test_lint_actually_sees_the_known_sites():
+    """The walker must FIND the guarded sites (an AST bug that finds
+    nothing would green-light everything)."""
+    found = collect_sites()
+    guarded = [(rel, name)
+               for rel, sites in found.items()
+               for _, name, _, g in sites if g
+               for rel2, name2 in [(rel, name)]]
+    # The driver's decision broadcast + exit barrier, and the
+    # checkpoint layer's broadcasts, are all wrapped today.
+    assert ("driver.py", "broadcast_one_to_all") in guarded
+    assert ("driver.py", "sync_global_devices") in guarded
+    assert any(rel == "runtime/checkpoint.py"
+               and name == "broadcast_one_to_all"
+               for rel, name in guarded)

@@ -1,0 +1,795 @@
+"""Elastic fleet membership — tier-1 units (ISSUE 6).
+
+The whole supervisor state machine runs here against scripted fake
+workers and a virtual clock (launch -> reshard -> rejoin -> done, the
+restart budget, backoff, MTTR measurement, verdict-file consumption),
+plus the fleet monitor's membership-verdict writes, the kv_suspect
+early forensic dump, the SIGABRT stack-hook lifecycle, the elastic
+mesh auto-sizing table, and the config <-> argv round trip.  The REAL
+3-process SIGKILL/rejoin soak is tests/test_elastic_multiproc.py
+(markers ``multiproc`` + ``slow``).
+"""
+
+import glob
+import json
+import os
+import signal
+import threading
+
+import pytest
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.obs import MetricsRegistry
+from scalable_agent_tpu.parallel.mesh import auto_data_axis
+from scalable_agent_tpu.runtime import elastic
+from scalable_agent_tpu.runtime.elastic import (
+    FATAL,
+    LOST,
+    OK,
+    RESHARDABLE,
+    RESTART_SAME,
+    DriverLauncher,
+    ElasticSupervisor,
+    _exit_status,
+    classify_exit,
+    compatible_fleet_size,
+    run_supervised,
+)
+from scalable_agent_tpu.runtime.exit_codes import (
+    FLEET_EXIT_CODE,
+    NONFINITE_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+)
+from scalable_agent_tpu.runtime.fleet import (
+    EPOCH_VERDICT_NAME,
+    FleetMonitor,
+)
+
+
+class VirtualClock:
+    """clock()/sleep() pair where sleeping advances time instantly."""
+
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.now += seconds
+
+
+class FakeWorker:
+    """Scripted worker.  Behaviors:
+
+    - ``("exit", code, delay_s)``: exits ``code`` once ``delay_s`` of
+      virtual time passed since launch (terminate() is ignored — a
+      worker already dying doesn't care).
+    - ``("until_term", code)``: runs until terminate(), then exits
+      ``code`` half a virtual second later (the grace-drain shape).
+
+    ``side_effect=(fn, at_s)`` fires ``fn`` once when first polled
+    ``at_s`` after launch — how tests grow the MTTR beacon file.
+    """
+
+    def __init__(self, clock, behavior, side_effect=None):
+        self._clock = clock
+        self._born = clock()
+        self._behavior = behavior
+        self._side_effect = side_effect
+        self._fired = False
+        self._terminated_at = None
+        self.pid = 4242
+
+    def poll(self):
+        now = self._clock()
+        if (self._side_effect and not self._fired
+                and now - self._born >= self._side_effect[1]):
+            self._fired = True
+            self._side_effect[0]()
+        kind = self._behavior[0]
+        if kind == "exit":
+            _, code, delay = self._behavior
+            return code if now - self._born >= delay else None
+        if kind == "until_term":
+            if (self._terminated_at is not None
+                    and now - self._terminated_at >= 0.5):
+                return self._behavior[1]
+            return None
+        raise AssertionError(f"unknown behavior {self._behavior!r}")
+
+    def terminate(self):
+        if self._terminated_at is None:
+            self._terminated_at = self._clock()
+
+
+class FakeLauncher:
+    """One scripted worker list per expected epoch; launching more
+    epochs than scripted (or at the wrong size) fails the test."""
+
+    def __init__(self, clock, scripts):
+        self._clock = clock
+        self._scripts = [list(s) for s in scripts]
+        self.launches = []
+
+    def launch(self, epoch, num_processes, port):
+        assert self._scripts, (
+            f"unexpected epoch {epoch} launch (script exhausted)")
+        script = self._scripts.pop(0)
+        assert len(script) == num_processes, (
+            f"epoch {epoch}: script has {len(script)} workers, "
+            f"supervisor launched {num_processes}")
+        self.launches.append((epoch, num_processes, port))
+        return [
+            FakeWorker(self._clock, b[0] if isinstance(b, tuple)
+                       and isinstance(b[0], tuple) else b,
+                       side_effect=(b[1] if isinstance(b, tuple)
+                                    and isinstance(b[0], tuple)
+                                    else None))
+            for b in script
+        ]
+
+
+def make_supervisor(tmp_path, clock, scripts, n=3, **kwargs):
+    launcher = FakeLauncher(clock, scripts)
+    kwargs.setdefault("restart_budget", 8)
+    kwargs.setdefault("stable_s", 1e9)
+    kwargs.setdefault("rejoin_delay_s", 1e9)
+    kwargs.setdefault("backoff_initial_s", 1.0)
+    kwargs.setdefault("backoff_cap_s", 8.0)
+    supervisor = ElasticSupervisor(
+        n, str(tmp_path), launcher,
+        poll_s=0.5, clock=clock, sleep=clock.sleep,
+        port_factory=lambda: 7777, registry=MetricsRegistry(),
+        **kwargs)
+    return supervisor, launcher
+
+
+def epoch_events(tmp_path):
+    path = os.path.join(str(tmp_path), elastic.EPOCHS_LOG_NAME)
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line)
+            for line in open(path).read().splitlines() if line]
+
+
+# ---------------------------------------------------------------------------
+# Exit-code policy
+
+
+class TestClassifyExit:
+    def test_policy_table(self):
+        assert classify_exit(0) == OK
+        assert classify_exit(FLEET_EXIT_CODE) == RESHARDABLE
+        assert classify_exit(NONFINITE_EXIT_CODE) == FATAL
+        assert classify_exit(WATCHDOG_EXIT_CODE) == RESTART_SAME
+        # SIGKILL = the host is gone; SIGABRT = jax's client fatal, a
+        # SURVIVOR of someone else's death (runtime/fleet.py).
+        assert classify_exit(-signal.SIGKILL) == LOST
+        assert classify_exit(137) == LOST
+        assert classify_exit(-signal.SIGABRT) == RESHARDABLE
+        assert classify_exit(134) == RESHARDABLE
+        # Garden-variety crash: restartable, host retained.
+        assert classify_exit(1) == RESHARDABLE
+
+
+# ---------------------------------------------------------------------------
+# Supervisor state machine (scripted fleets, virtual clock)
+
+
+class TestFleetSizeCompatibility:
+    def test_largest_dividing_size_wins(self):
+        # batch 256, 4 hosts, one lost: 3 doesn't divide -> run 2.
+        assert compatible_fleet_size(256, 4) == 4
+        assert compatible_fleet_size(256, 3) == 2
+        assert compatible_fleet_size(6, 4) == 3
+        assert compatible_fleet_size(7, 3) == 1  # prime batch: solo
+        assert compatible_fleet_size(None, 5) == 5  # unconstrained
+
+    def test_exit_status_translates_signals(self):
+        assert _exit_status(-signal.SIGSEGV) == 139
+        assert _exit_status(-signal.SIGTERM) == 143
+        assert _exit_status(0) == 0
+        assert _exit_status(FLEET_EXIT_CODE) == FLEET_EXIT_CODE
+
+
+class TestSupervisorRun:
+    def test_clean_completion_returns_zero_after_one_epoch(
+            self, tmp_path):
+        clock = VirtualClock()
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock, [[("exit", 0, 1.0)] * 3])
+        assert supervisor.run() == 0
+        assert [(e, n) for e, n, _ in launcher.launches] == [(0, 3)]
+        events = epoch_events(tmp_path)
+        assert [e["event"] for e in events] == ["launch", "exit"]
+        assert events[1]["outcome"] == "done"
+
+    def test_sigkill_reshards_to_n_minus_1_then_completes(
+            self, tmp_path):
+        clock = VirtualClock()
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [
+                # Slot 1's host dies; the survivors exit 72 bounded.
+                [("exit", FLEET_EXIT_CODE, 6.0), ("exit", -9, 1.0),
+                 ("exit", FLEET_EXIT_CODE, 6.0)],
+                [("exit", 0, 1.0)] * 2,
+            ])
+        assert supervisor.run() == 0
+        assert [(e, n) for e, n, _ in launcher.launches] == [
+            (0, 3), (1, 2)]
+        events = epoch_events(tmp_path)
+        exits = [e for e in events if e["event"] == "exit"]
+        assert exits[0]["outcome"] == "reshard"
+        assert exits[0]["lost_slots"] == [1]
+        assert exits[1]["outcome"] == "done"
+        # One membership-size change counted.
+        assert supervisor._resizes.value == 1
+        assert supervisor.available_slots() == [0, 2]
+
+    def test_reshard_skips_batch_incompatible_size(self, tmp_path):
+        """batch 256 over 4 hosts: losing one cannot relaunch as 3
+        (256 % 3 != 0) — the supervisor runs 2 and idles the third
+        slot instead of dying at launch."""
+        clock = VirtualClock()
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [
+                [("exit", FLEET_EXIT_CODE, 6.0), ("exit", -9, 1.0),
+                 ("exit", FLEET_EXIT_CODE, 6.0),
+                 ("exit", FLEET_EXIT_CODE, 6.0)],
+                [("exit", 0, 1.0)] * 2,
+            ],
+            n=4, batch_size=256)
+        assert supervisor.run() == 0
+        assert [(e, n) for e, n, _ in launcher.launches] == [
+            (0, 4), (1, 2)]
+        launch1 = [e for e in epoch_events(tmp_path)
+                   if e["event"] == "launch"][1]
+        # The first two surviving slots run; slot 3 idles this epoch.
+        assert launch1["slots"] == [0, 2]
+
+    def test_persistent_segfaults_exit_posix_status(self, tmp_path):
+        """A fleet that keeps dying -11 must exhaust the budget with
+        the POSIX 139, not a raw negative Popen code (the OS would
+        render -11 as a meaningless 245)."""
+        clock = VirtualClock()
+        supervisor, _ = make_supervisor(
+            tmp_path, clock,
+            [[("exit", -signal.SIGSEGV, 0.5)]] * 2,
+            n=1, restart_budget=1)
+        assert supervisor.run() == 139
+
+    def test_rejoin_scales_back_up_at_checkpoint_boundary(
+            self, tmp_path):
+        clock = VirtualClock()
+        beacon = os.path.join(str(tmp_path), "metrics.jsonl")
+
+        def grow_beacon():
+            with open(beacon, "a") as f:
+                f.write('{"update": 1}\n')
+
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [
+                [("exit", FLEET_EXIT_CODE, 6.0), ("exit", -9, 1.0),
+                 ("exit", FLEET_EXIT_CODE, 6.0)],
+                # The resharded fleet trains (grows the beacon) until
+                # the supervisor drains it for the scale-up.
+                [(("until_term", 0), (grow_beacon, 2.0)),
+                 ("until_term", 0)],
+                [("exit", 0, 1.0)] * 3,
+            ],
+            rejoin_delay_s=30.0)
+        assert supervisor.run() == 0
+        assert [(e, n) for e, n, _ in launcher.launches] == [
+            (0, 3), (1, 2), (2, 3)]
+        events = epoch_events(tmp_path)
+        outcomes = [e["outcome"] for e in events
+                    if e["event"] == "exit"]
+        assert outcomes == ["reshard", "scale_up", "done"]
+        assert any(e["event"] == "scale_up_drain" for e in events)
+        # Down to 2 then back to 3: two membership-size changes.
+        assert supervisor._resizes.value == 2
+        assert supervisor.available_slots() == [0, 1, 2]
+        # MTTR: first observed death (epoch 0) -> beacon growth
+        # (epoch 1), measured on the virtual clock.
+        mttrs = [e for e in events if e["event"] == "mttr"]
+        assert len(mttrs) == 1
+        assert 0.0 < mttrs[0]["mttr_s"] < 60.0
+        assert supervisor._last_mttr_s == pytest.approx(
+            mttrs[0]["mttr_s"], abs=1e-6)
+
+    def test_rejoin_marker_file_forces_early_rejoin(self, tmp_path):
+        clock = VirtualClock()
+        (tmp_path / "rejoin.1").write_text("back")
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [
+                [("exit", FLEET_EXIT_CODE, 6.0), ("exit", -9, 1.0),
+                 ("exit", FLEET_EXIT_CODE, 6.0)],
+                [("until_term", 0)] * 2,
+                [("exit", 0, 1.0)] * 3,
+            ],
+            rejoin_delay_s=1e9)  # only the marker can trigger it
+        assert supervisor.run() == 0
+        assert [n for _, n, _ in launcher.launches] == [3, 2, 3]
+        # The consumed marker is deleted at rejoin.
+        assert not (tmp_path / "rejoin.1").exists()
+
+    def test_preempt_verdict_relaunches_instead_of_finishing(
+            self, tmp_path):
+        clock = VirtualClock()
+
+        # A drained preemption exits 0 everywhere — only the
+        # epoch-stamped verdict (written by the FLEET mid-epoch, like
+        # the real monitor does) tells the supervisor to relaunch.
+        def write_preempt_verdict():
+            (tmp_path / EPOCH_VERDICT_NAME).write_text(json.dumps(
+                {"epoch": 0, "kind": "preempt"}))
+
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [[(("exit", 0, 1.0), (write_preempt_verdict, 0.5))],
+             [("exit", 0, 1.0)]], n=1)
+        assert supervisor.run() == 0
+        # Epoch 0's clean exit re-read as a preemption; epoch 1's
+        # clean exit finds the verdict CLEARED at its launch -> done.
+        assert [e for e, _, _ in launcher.launches] == [0, 1]
+
+    def test_stale_incarnation_verdict_cleared_at_launch(
+            self, tmp_path):
+        """A fleet_epoch.json left by a PREVIOUS supervisor
+        incarnation (epoch numbering restarts at 0, so the epoch-match
+        check alone would accept it) must not re-read a finished run
+        as a preemption."""
+        clock = VirtualClock()
+        (tmp_path / EPOCH_VERDICT_NAME).write_text(json.dumps(
+            {"epoch": 0, "kind": "preempt"}))
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock, [[("exit", 0, 1.0)]], n=1)
+        assert supervisor.run() == 0
+        assert len(launcher.launches) == 1  # done, no phantom relaunch
+
+    def test_fatal_nonfinite_stops_the_supervisor(self, tmp_path):
+        clock = VirtualClock()
+        supervisor, _ = make_supervisor(
+            tmp_path, clock,
+            [[("exit", NONFINITE_EXIT_CODE, 1.0)]], n=1)
+        assert supervisor.run() == NONFINITE_EXIT_CODE
+
+    def test_restart_budget_exhausts_with_backoff(self, tmp_path):
+        clock = VirtualClock()
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [[("exit", 1, 0.5)], [("exit", 1, 0.5)]],
+            n=1, restart_budget=1)
+        assert supervisor.run() == 1
+        assert len(launcher.launches) == 2
+        assert any(e["event"] == "budget_exhausted"
+                   for e in epoch_events(tmp_path))
+
+    def test_stable_epoch_resets_the_budget(self, tmp_path):
+        clock = VirtualClock()
+        # budget=1: two UNRESET consecutive failures would exhaust it.
+        # Epoch 1 runs past stable_s before failing, so its failure
+        # charges from a reset counter and the fleet relaunches.
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [[("exit", 1, 0.5)],       # failure 1/1
+             [("exit", 1, 20.0)],      # stable: reset, then 1/1
+             [("exit", 0, 0.5)]],
+            n=1, restart_budget=1, stable_s=10.0)
+        assert supervisor.run() == 0
+        assert len(launcher.launches) == 3
+
+    def test_shutdown_request_drains_and_exits_zero(self, tmp_path):
+        clock = VirtualClock()
+        box = {}
+
+        def request_shutdown():
+            box["supervisor"]._shutdown_requested = True
+
+        supervisor, launcher = make_supervisor(
+            tmp_path, clock,
+            [[(("until_term", 0), (request_shutdown, 2.0)),
+              ("until_term", 0), ("until_term", 0)]])
+        box["supervisor"] = supervisor
+        assert supervisor.run() == 0
+        events = epoch_events(tmp_path)
+        assert events[-1]["outcome"] == "shutdown"
+
+    def test_shutdown_between_epochs_launches_nothing(self, tmp_path):
+        clock = VirtualClock()
+        supervisor, launcher = make_supervisor(tmp_path, clock, [])
+        supervisor._shutdown_requested = True
+        assert supervisor.run() == 0
+        assert launcher.launches == []
+
+    def test_backoff_is_capped_exponential(self, tmp_path):
+        clock = VirtualClock()
+        supervisor, _ = make_supervisor(
+            tmp_path, clock, [], backoff_initial_s=1.0,
+            backoff_cap_s=8.0)
+        assert supervisor.backoff_s() == 0.0
+        observed = []
+        for failures in range(1, 7):
+            supervisor._consecutive_failures = failures
+            observed.append(supervisor.backoff_s())
+        assert observed == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+# ---------------------------------------------------------------------------
+# Launcher command construction + run_supervised validation
+
+
+class TestDriverLauncher:
+    def test_worker_command_carries_epoch_and_distributed_flags(
+            self, monkeypatch):
+        calls = []
+
+        class FakePopen:
+            def __init__(self, args, env=None):
+                calls.append((args, env))
+                self.pid = 1
+
+        monkeypatch.setattr(elastic.subprocess, "Popen", FakePopen)
+        config = Config(batch_size=6, elastic=True, fleet_epoch=9,
+                        distributed_num_processes=3,
+                        logdir="/tmp/elastic_x")
+        workers = DriverLauncher(config).launch(
+            epoch=2, num_processes=2, port=777)
+        assert len(workers) == 2
+        args0, args1 = calls[0][0], calls[1][0]
+        assert "--fleet_epoch=2" in args0
+        assert "--distributed_coordinator=localhost:777" in args0
+        assert "--distributed_num_processes=2" in args0
+        assert "--distributed_process_id=0" in args0
+        assert "--distributed_process_id=1" in args1
+        assert "--batch_size=6" in args0
+        # Supervisor-owned fields must not leak into workers — a
+        # worker relaunching the supervisor would fork-bomb.
+        assert not any(a.startswith("--elastic=") for a in args0)
+
+    def test_run_supervised_rejects_indivisible_batch(self):
+        config = Config(batch_size=5, elastic=True,
+                        distributed_num_processes=2)
+        with pytest.raises(ValueError, match="not divisible"):
+            run_supervised(config)
+
+    def test_config_argv_round_trip(self):
+        config = Config(batch_size=6, elastic=True, fleet_epoch=4,
+                        peer_timeout_s=7.5, level_name="fake_small")
+        rebuilt = Config.from_argv(config.to_argv())
+        assert rebuilt == config
+        # to_argv(exclude=...) drops the named fields back to default.
+        stripped = Config.from_argv(
+            config.to_argv(exclude=("elastic", "fleet_epoch")))
+        assert not stripped.elastic
+        assert stripped.fleet_epoch == 0
+        assert stripped.batch_size == 6
+
+
+# ---------------------------------------------------------------------------
+# Elastic mesh auto-sizing (parallel/mesh.py)
+
+
+class TestAutoDataAxis:
+    def test_adapts_across_device_counts(self):
+        # One global batch of 32 resharding over whatever devices the
+        # membership epoch has — the elastic invariant.
+        assert auto_data_axis(32, 8) == 8
+        assert auto_data_axis(32, 6) == 2
+        assert auto_data_axis(32, 4) == 4
+        assert auto_data_axis(32, 1) == 1
+        # Batch smaller than the host: use a divisor, don't fail.
+        assert auto_data_axis(4, 8) == 4
+        assert auto_data_axis(6, 8) == 2
+        # seq/model take their devices first.
+        assert auto_data_axis(32, 8, seq=2) == 4
+        assert auto_data_axis(32, 8, model=2) == 4
+        assert auto_data_axis(32, 8, seq=2, model=2) == 2
+
+    def test_matches_driver_resolution(self, monkeypatch):
+        import jax
+
+        from scalable_agent_tpu.driver import resolve_mesh_data
+
+        config = Config(batch_size=32, mesh_data=0)
+        assert resolve_mesh_data(config) == auto_data_axis(
+            32, len(jax.devices()))
+
+
+# ---------------------------------------------------------------------------
+# Fleet monitor: membership verdicts + kv_suspect early dump
+
+
+class FakeKV:
+    def __init__(self):
+        self.store = {}
+        self.fail_with = None
+
+    def _maybe_fail(self):
+        if self.fail_with is not None:
+            raise self.fail_with
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self._maybe_fail()
+        self.store[key] = value
+
+    def key_value_dir_get(self, prefix):
+        self._maybe_fail()
+        return [(k, v) for k, v in self.store.items()
+                if k.startswith(prefix)]
+
+
+class Clock:
+    def __init__(self, start=100.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+class RecorderStub:
+    def __init__(self):
+        self.events = []
+        self.dumps = []
+        self.reason_pin = None
+        self.dumped = threading.Event()
+
+    def record(self, kind, name, args=None):
+        self.events.append((kind, name, args))
+
+    def dump_all(self, reason, **kwargs):
+        self.dumps.append(reason)
+        self.dumped.set()
+
+
+def make_monitor(tmp_path, clock, kv, epoch=0, recorder=None,
+                 timeout=5.0):
+    fatals = []
+    monitor = FleetMonitor(
+        peer_timeout_s=timeout, preemption_grace_s=0.0,
+        registry=MetricsRegistry(), process_index=0, num_processes=2,
+        kv=kv, clock=clock, on_fatal=fatals.append,
+        host_exit_linger_s=0.0, epoch=epoch,
+        logdir=str(tmp_path),
+        recorder=recorder or RecorderStub())
+    monitor._test_fatals = fatals
+    return monitor
+
+
+class TestMembershipVerdict:
+    def test_peer_lost_fatal_writes_epoch_verdict(self, tmp_path):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(tmp_path, clock, kv, epoch=3)
+        kv.store["fleet/hb/1"] = "1"
+        monitor.publish_once()
+        monitor.monitor_once()
+        monitor.note_checkpoint(7)
+        monitor.note_checkpoint(5)  # older step never regresses it
+        clock.now += 6.0
+        monitor.publish_once()  # own plane fresh: verdict may land
+        monitor.monitor_once()
+        assert monitor._test_fatals == [FLEET_EXIT_CODE]
+        verdict = json.load(
+            open(os.path.join(str(tmp_path), EPOCH_VERDICT_NAME)))
+        assert verdict["epoch"] == 3
+        assert verdict["kind"] == "peer_lost"
+        assert verdict["lost_peers"] == [1]
+        assert verdict["last_verified_step"] == 7
+        assert verdict["num_processes"] == 2
+
+    def test_preempt_decision_writes_epoch_verdict(self, tmp_path):
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(tmp_path, clock, kv, epoch=1)
+        monitor._grace.grace_s = 30.0
+        monitor.note_checkpoint(4)
+        monitor.note_preempt_decision(12)
+        verdict = json.load(
+            open(os.path.join(str(tmp_path), EPOCH_VERDICT_NAME)))
+        assert verdict["kind"] == "preempt"
+        assert verdict["epoch"] == 1
+        assert verdict["detail"]["update"] == 12
+        assert verdict["last_verified_step"] == 4
+
+    def test_unwinding_exception_writes_collective_error_verdict(
+            self, tmp_path):
+        """The driver's finally lands a verdict when an exception is
+        unwinding a multi-process run — the aborted collective's
+        XlaRuntimeError (then jax's own SIGABRT) can otherwise end the
+        process before the monitor's heartbeat verdict exists."""
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(tmp_path, clock, kv, epoch=2)
+        monitor.note_checkpoint(9)
+        monitor.note_fatal_error(RuntimeError("gloo all-reduce failed"))
+        verdict = json.load(
+            open(os.path.join(str(tmp_path), EPOCH_VERDICT_NAME)))
+        assert verdict["kind"] == "collective_error"
+        assert verdict["epoch"] == 2
+        assert verdict["last_verified_step"] == 9
+        assert verdict["detail"]["error_type"] == "RuntimeError"
+
+    def test_monitor_verdict_keeps_precedence_over_exception(
+            self, tmp_path):
+        """Once the monitor's own fatal fired (richer: names the stale
+        peer), a late note_fatal_error must not clobber it."""
+        clock, kv = Clock(), FakeKV()
+        monitor = make_monitor(tmp_path, clock, kv, epoch=4)
+        kv.store["fleet/hb/1"] = "1"
+        monitor.publish_once()
+        monitor.monitor_once()
+        clock.now += 6.0
+        monitor.publish_once()
+        monitor.monitor_once()
+        assert monitor._test_fatals == [FLEET_EXIT_CODE]
+        monitor.note_fatal_error(RuntimeError("late unwind"))
+        verdict = json.load(
+            open(os.path.join(str(tmp_path), EPOCH_VERDICT_NAME)))
+        assert verdict["kind"] == "peer_lost"
+
+    def test_note_fatal_error_noop_single_process(self, tmp_path):
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, preemption_grace_s=30.0,
+            registry=MetricsRegistry(), process_index=0,
+            num_processes=1, kv=None, clock=Clock(),
+            on_fatal=lambda code: None, host_exit_linger_s=0.0,
+            logdir=str(tmp_path), recorder=RecorderStub())
+        monitor.note_fatal_error(RuntimeError("local bug"))
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), EPOCH_VERDICT_NAME))
+
+    def test_no_logdir_writes_nothing(self, tmp_path):
+        clock, kv = Clock(), FakeKV()
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            process_index=0, num_processes=2, kv=kv, clock=clock,
+            on_fatal=lambda code: None, host_exit_linger_s=0.0,
+            recorder=RecorderStub())
+        monitor._write_epoch_verdict("peer_lost", {})
+        assert not glob.glob(os.path.join(str(tmp_path), "*.json"))
+
+    def test_epoch_gauge_registered(self, tmp_path):
+        registry = MetricsRegistry()
+        FleetMonitor(
+            peer_timeout_s=5.0, registry=registry, process_index=0,
+            num_processes=2, kv=FakeKV(), clock=Clock(),
+            on_fatal=lambda code: None, host_exit_linger_s=0.0,
+            epoch=5, logdir=str(tmp_path), recorder=RecorderStub())
+        assert registry.gauge("fleet/epoch").value == 5.0
+
+
+class TestKvSuspectEarlyDump:
+    def test_first_kv_failure_fires_one_early_dump(self, tmp_path):
+        clock, kv = Clock(), FakeKV()
+        recorder = RecorderStub()
+        monitor = make_monitor(tmp_path, clock, kv, recorder=recorder,
+                               timeout=60.0)
+        kv.fail_with = RuntimeError("connection refused")
+        monitor.monitor_once()
+        assert recorder.dumped.wait(timeout=5.0)
+        assert recorder.dumps == ["fleet:kv_suspect"]
+        assert any(kind == "fleet_suspect"
+                   for kind, _, _ in recorder.events)
+        # Later failing polls must NOT re-dump (once per run).
+        clock.now += 1.0
+        monitor.monitor_once()
+        assert recorder.dumps == ["fleet:kv_suspect"]
+        # No fatal yet: the deadline still owns the verdict.
+        assert monitor._test_fatals == []
+
+
+# ---------------------------------------------------------------------------
+# SIGABRT stack-hook lifecycle (obs/flightrec.py)
+
+
+class TestSigabrtHook:
+    """The hook must be proven in SUBPROCESSES: pytest's own
+    faulthandler plugin keeps the in-process handler enabled (which
+    the hook correctly refuses to hijack), and a real ``os.abort()``
+    would kill the test runner."""
+
+    HEADER = (
+        "import glob, os, sys\n"
+        "sys.path.insert(0, {repo!r})\n"
+        "from scalable_agent_tpu.obs.flightrec import (\n"
+        "    FlightRecorder, install_crash_handlers)\n"
+        "rec = FlightRecorder(logdir={logdir!r})\n"
+        "uninstall = install_crash_handlers(rec)\n"
+        "paths = glob.glob(os.path.join({logdir!r}, "
+        "'stacks.sigabrt.*.txt'))\n"
+        "assert len(paths) == 1, paths\n"
+    )
+
+    @staticmethod
+    def _run(body, logdir):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        script = TestSigabrtHook.HEADER.format(
+            repo=repo, logdir=str(logdir)) + body
+        return subprocess.run(
+            [sys.executable, "-c", script], capture_output=True,
+            text=True, timeout=60)
+
+    def test_clean_uninstall_leaves_no_litter(self, tmp_path):
+        proc = self._run(
+            "uninstall()\n"
+            "assert not glob.glob(os.path.join({logdir!r}, "
+            "'stacks.sigabrt.*.txt'))\n".format(logdir=str(tmp_path)),
+            tmp_path)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
+    def test_real_abort_lands_thread_stacks(self, tmp_path):
+        # The jax-client-fatal shape: abort() from a live process.
+        # The C-level faulthandler must land every thread's stack in
+        # the pre-opened file as the process dies with signal 6.
+        proc = self._run("os.abort()\n", tmp_path)
+        assert proc.returncode == -signal.SIGABRT, proc.stderr[-2000:]
+        paths = glob.glob(
+            os.path.join(str(tmp_path), "stacks.sigabrt.*.txt"))
+        assert len(paths) == 1
+        content = open(paths[0]).read()
+        assert "Aborted" in content and "thread" in content, (
+            content[:500])
+
+
+# ---------------------------------------------------------------------------
+# Aggregation: membership series fold rules + supervisor snapshot
+
+
+class TestAggregationFolds:
+    def test_epoch_and_mttr_fold_max(self):
+        from scalable_agent_tpu.obs.aggregate import (
+            aggregate_prometheus,
+        )
+
+        texts = {
+            "0": ("# TYPE impala_fleet_epoch gauge\n"
+                  "impala_fleet_epoch 3.0\n"
+                  "# TYPE impala_fleet_mttr_s gauge\n"
+                  "impala_fleet_mttr_s 12.5\n"),
+            "1": ("# TYPE impala_fleet_epoch gauge\n"
+                  "impala_fleet_epoch 2.0\n"
+                  "# TYPE impala_fleet_mttr_s gauge\n"
+                  "impala_fleet_mttr_s 40.0\n"),
+        }
+        out = aggregate_prometheus(texts)
+        assert 'impala_fleet_epoch{fold="max"} 3.0' in out
+        assert 'impala_fleet_mttr_s{fold="max"} 40.0' in out
+
+    def test_supervisor_prom_gets_its_own_label(self, tmp_path):
+        from scalable_agent_tpu.obs.aggregate import find_artifacts
+
+        (tmp_path / "metrics.prom").write_text("")
+        (tmp_path / "metrics.p1.prom").write_text("")
+        (tmp_path / "metrics.supervisor.prom").write_text("")
+        _, proms = find_artifacts(str(tmp_path))
+        assert set(proms) == {"0", "1", "supervisor"}
+
+
+# ---------------------------------------------------------------------------
+# Supervisor steady-state cycle (the bench-timed surface)
+
+
+class TestWatchCycle:
+    def test_cycle_reports_codes_and_mttr(self, tmp_path):
+        clock = VirtualClock()
+        supervisor, _ = make_supervisor(tmp_path, clock, [])
+        workers = [FakeWorker(clock, ("exit", 0, 5.0))
+                   for _ in range(3)]
+        codes, mttr = supervisor.watch_cycle(workers, 0, None)
+        assert codes == [None, None, None]
+        assert mttr is None
+        # Beacon growth with an anchor -> MTTR measured.
+        beacon = tmp_path / "metrics.jsonl"
+        beacon.write_text('{"update": 1}\n')
+        clock.now += 7.0
+        codes, mttr = supervisor.watch_cycle(
+            workers, 0, clock.now - 3.0)
+        assert codes == [0, 0, 0]
+        assert mttr == pytest.approx(3.0)

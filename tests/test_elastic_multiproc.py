@@ -1,0 +1,249 @@
+"""ISSUE 6 acceptance: elastic membership against a REAL fleet.
+
+One end-to-end soak over the real supervisor
+(``python -m scalable_agent_tpu.runtime.elastic``) driving a real
+3-process ``jax.distributed`` training fleet on localhost CPU:
+
+1. epoch 0 trains at N=3 and lands a durable checkpoint;
+2. one worker is SIGKILLed — the survivors exit 72, the supervisor
+   reshards, and epoch 1 continues as a 2-process fleet resuming from
+   the newest verified checkpoint (MTTR recorded);
+3. the lost slot rejoins (marker file) — the supervisor drains the
+   fleet through the grace protocol at a checkpoint boundary and
+   epoch 2 runs at N=3 again;
+4. the supervisor is SIGTERMed — the fleet drains to one final
+   coordinated verified checkpoint and everything exits 0 — and the
+   final checkpoint's ``env_frames`` is EXACTLY ``step x
+   frames_per_update``: nothing double-counted across two reshards
+   and two restores.
+
+Markers ``multiproc`` + ``slow``: excluded from tier-1 (the soak
+stands up three real fleets back to back).
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.multiproc, pytest.mark.slow]
+
+FAKES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "fakes")
+# Scoped import (see tests/test_fleet_multiproc.py): the fakes dir also
+# shadows real simulator packages for find_spec.
+sys.path.insert(0, FAKES_DIR)
+try:
+    import multiproc  # noqa: E402
+finally:
+    sys.path.remove(FAKES_DIR)
+
+N = 3
+FPU = 6 * 3 * 1  # batch 6 x unroll 3 x repeats 1
+SUPERVISOR_ARGS = [
+    "--mode=train", "--level_name=fake_small",
+    "--num_actors=4", "--batch_size=6", "--unroll_length=3",
+    "--num_action_repeats=1", "--height=16", "--width=16",
+    "--num_env_workers_per_group=1", "--compute_dtype=float32",
+    "--log_interval_s=0.2", "--seed=3",
+    "--checkpoint_interval_s=1.0",
+    "--peer_timeout_s=6", "--preemption_grace_s=45",
+    "--total_environment_frames=1000000",
+    f"--distributed_num_processes={N}",
+    # Rejoin is marker-gated: the test controls WHEN the lost host
+    # "comes back".
+    "--elastic_rejoin_delay_s=1000000",
+    "--elastic_restart_budget=4",
+]
+
+
+def _events(logdir):
+    path = os.path.join(logdir, "fleet_epochs.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    for line in open(path).read().splitlines():
+        if line:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass  # torn tail mid-append
+    return out
+
+
+def _retained_steps(logdir):
+    steps = []
+    for name in glob.glob(os.path.join(logdir, "checkpoints", "*")):
+        base = os.path.basename(name)
+        if base.isdigit():
+            steps.append(int(base))
+    return sorted(steps)
+
+
+def _wait_for(predicate, supervisor, deadline_s, what):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        if supervisor.poll() is not None:
+            pytest.fail(
+                f"supervisor exited early ({supervisor.returncode}) "
+                f"waiting for {what}")
+        time.sleep(0.5)
+    pytest.fail(f"no {what} within {deadline_s:.0f}s")
+
+
+def test_sigkill_reshard_then_rejoin_frame_exact(tmp_path):
+    logdir = str(tmp_path / "run")
+    env = multiproc.base_env(devices_per_process=1)
+    # Worker processes INHERIT the supervisor's stdout: it must be a
+    # file, not a pipe nobody drains (a full pipe buffer would block
+    # every worker's logging mid-training).
+    console_path = str(tmp_path / "console.log")
+    console = open(console_path, "w")
+    supervisor = subprocess.Popen(
+        [sys.executable, "-m", "scalable_agent_tpu.runtime.elastic",
+         "--logdir", logdir] + SUPERVISOR_ARGS,
+        cwd=multiproc.REPO, env=env, stdout=console,
+        stderr=subprocess.STDOUT)
+
+    def console_tail():
+        try:
+            return open(console_path).read()[-4000:]
+        except OSError:
+            return "<no console output>"
+    try:
+        # -- epoch 0: N=3 up, first durable checkpoint.
+        launch0 = _wait_for(
+            lambda: next((e for e in _events(logdir)
+                          if e["event"] == "launch"
+                          and e["epoch"] == 0), None),
+            supervisor, 120, "epoch 0 launch record")
+        assert launch0["num_processes"] == N
+        assert launch0["slots"] == [0, 1, 2]
+        _wait_for(lambda: len(_retained_steps(logdir)) >= 1,
+                  supervisor, 300, "first durable checkpoint")
+        pre_kill_latest = _retained_steps(logdir)[-1]
+
+        # -- kill one NON-coordinator worker's host.
+        os.kill(launch0["pids"][1], signal.SIGKILL)
+
+        # -- epoch 1: the supervisor reshards to N-1.
+        launch1 = _wait_for(
+            lambda: next((e for e in _events(logdir)
+                          if e["event"] == "launch"
+                          and e["epoch"] == 1), None),
+            supervisor, 180, "epoch 1 (resharded) launch record")
+        assert launch1["num_processes"] == N - 1
+        assert launch1["slots"] == [0, 2]
+        exit0 = next(e for e in _events(logdir)
+                     if e["event"] == "exit" and e["epoch"] == 0)
+        assert exit0["outcome"] == "reshard"
+        assert exit0["lost_slots"] == [1]
+        # The survivors' membership verdict named the lost peer.
+        # The survivors' membership verdict rode into the exit record
+        # (the FILE is transient — the supervisor consumes it and
+        # clears it before the next launch).  WHICH kind lands is a
+        # race three ways bounded: the monitor's heartbeat verdict
+        # (peer_lost), the coordinator-death shape (kv_unreachable),
+        # or the aborted collective's exception unwinding first
+        # (collective_error via note_fatal_error — gloo fails fast on
+        # a reset connection, and jax's client fatal can SIGABRT the
+        # survivor mid-teardown).
+        assert exit0["verdict_kind"] in (
+            "peer_lost", "kv_unreachable", "collective_error")
+
+        # -- the 2-process fleet makes VERIFIED progress + MTTR lands.
+        _wait_for(
+            lambda: (_retained_steps(logdir)
+                     and _retained_steps(logdir)[-1] > pre_kill_latest),
+            supervisor, 300, "post-reshard checkpoint progress")
+        mttr = _wait_for(
+            lambda: next((e for e in _events(logdir)
+                          if e["event"] == "mttr"), None),
+            supervisor, 120, "MTTR record")
+        assert 0.0 < mttr["mttr_s"] < 300.0
+
+        # -- rejoin: the lost host comes back; scale-up at the next
+        #    checkpoint boundary (the coordinated grace drain).
+        open(os.path.join(logdir, "rejoin.1"), "w").write("back")
+        launch2 = _wait_for(
+            lambda: next((e for e in _events(logdir)
+                          if e["event"] == "launch"
+                          and e["epoch"] == 2), None),
+            supervisor, 300, "epoch 2 (rejoined) launch record")
+        assert launch2["num_processes"] == N
+        assert launch2["slots"] == [0, 1, 2]
+        exit1 = next(e for e in _events(logdir)
+                     if e["event"] == "exit" and e["epoch"] == 1)
+        assert exit1["outcome"] == "scale_up"
+        assert exit1["codes"] == [0, 0]  # graceful drain, not a crash
+        boundary_step = _retained_steps(logdir)[-1]
+
+        # -- the full-size fleet makes progress again, then the
+        #    supervisor is preempted: drain everything, exit 0.
+        _wait_for(
+            lambda: (_retained_steps(logdir)
+                     and _retained_steps(logdir)[-1] > boundary_step),
+            supervisor, 300, "post-rejoin checkpoint progress")
+        supervisor.send_signal(signal.SIGTERM)
+        supervisor.wait(timeout=240)
+        assert supervisor.returncode == 0, console_tail()
+        exit2 = next(e for e in _events(logdir)
+                     if e["event"] == "exit" and e["epoch"] == 2)
+        assert exit2["outcome"] == "shutdown"
+        assert exit2["codes"] == [0, 0, 0]
+    finally:
+        if supervisor.poll() is None:
+            supervisor.kill()
+            supervisor.wait(timeout=60)
+        console.close()
+        # The supervisor's own children die with it on the kill path:
+        # any straggler worker pid recorded in the epoch log is
+        # hard-killed so a failing assertion can't leak interpreters.
+        for event in _events(logdir):
+            if event["event"] == "launch":
+                for pid in event.get("pids") or []:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (OSError, TypeError):
+                        pass
+
+    # -- membership history is one machine-readable timeline.
+    launches = [e for e in _events(logdir) if e["event"] == "launch"]
+    assert [e["num_processes"] for e in launches] == [3, 2, 3]
+    prom = open(os.path.join(logdir, "metrics.supervisor.prom")).read()
+    assert "impala_fleet_resize_total 2.0" in prom
+    assert "impala_fleet_mttr_s" in prom
+
+    # -- frame-exact accounting across BOTH reshards: the newest
+    #    verified checkpoint's on-device counter is exactly
+    #    updates x frames_per_update.
+    steps = _retained_steps(logdir)
+    assert steps, "no checkpoint survived the run"
+    latest = steps[-1]
+    assert os.path.exists(os.path.join(
+        logdir, "checkpoints", "manifests", f"{latest}.json"))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(logdir)
+    try:
+        step, restored = ckpt.restore()
+        assert step == latest
+        assert float(np.asarray(restored["env_frames"])) == step * FPU
+        # The N-process checkpoint restores here at 1 process with its
+        # manifest verifying — the N±1 restore contract, natively.
+        manifest_topology = ckpt.saved_topology(step)
+        assert manifest_topology["num_processes"] in (N, N - 1)
+    finally:
+        ckpt.close()

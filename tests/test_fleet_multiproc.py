@@ -164,6 +164,78 @@ def test_sigkill_peer_survivors_exit_72_with_forensics(tmp_path):
         assert "peer_lost" in kinds
 
 
+def test_coordinator_sigkill_bounded_with_forensics(tmp_path):
+    """PR 5's known bound, mitigated (ISSUE 6): SIGKILL the
+    COORDINATOR.  On this jaxlib the survivors' own client fatal
+    (SIGABRT via the ``PollForError`` long-poll, which notices the
+    closed socket in ~2s) outruns every KV-poll deadline — ``abort()``
+    runs no Python, so the ring dump CANNOT fire on that path.  Each
+    survivor must still (a) die BOUNDED (72 or SIGABRT, never a hang)
+    and (b) leave forensics on disk: an aborted survivor's guaranteed
+    artifact is the C-level faulthandler stack dump
+    (``stacks.sigabrt.<pid>.txt``, non-empty); a survivor that instead
+    reached the ``kv_unreachable`` verdict (shapes where the service
+    degrades WITHOUT a client fatal) leaves the fleet-attributed ring
+    dump."""
+    logdir = str(tmp_path)
+    body = (
+        "import pathlib, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from scalable_agent_tpu.parallel.distributed import (\n"
+        "    initialize_distributed)\n"
+        "initialize_distributed('localhost:{port}', {n}, {proc})\n"
+        "from scalable_agent_tpu.obs import configure_flight_recorder\n"
+        "from scalable_agent_tpu.obs.flightrec import (\n"
+        "    install_crash_handlers)\n"
+        "from scalable_agent_tpu.runtime.fleet import configure_fleet\n"
+        f"rec = configure_flight_recorder(r'{logdir}', "
+        "process_index={proc})\n"
+        "install_crash_handlers(rec)\n"
+        "configure_fleet(5.0, preemption_grace_s=0.0, recorder=rec,\n"
+        f"                logdir=r'{logdir}')\n"
+        f"pathlib.Path(r'{logdir}', 'ready.{{proc}}').write_text('up')\n"
+        "time.sleep(600)\n"
+    )
+    with multiproc.FleetHarness(N, devices_per_process=1) as harness:
+        harness.spawn_script(body)
+        _wait_for(
+            lambda: all(os.path.exists(os.path.join(logdir,
+                                                    f"ready.{i}"))
+                        for i in range(N)),
+            harness, 120, "fleet-up sentinels")
+        pids = [p.pid for p in harness.procs]
+        harness.kill(0)  # the coordination-service host
+        results = harness.wait_all(timeout_s=90)
+    assert results[0][0] == -9
+    import signal as signal_lib
+
+    abort_codes = (-signal_lib.SIGABRT, 128 + signal_lib.SIGABRT)
+    for index in (1, 2):
+        code, out = results[index]
+        assert code in (FLEET_EXIT_CODE,) + abort_codes, (
+            f"survivor {index} exited {code} — neither the bounded 72 "
+            f"nor jax's own abort:\n{out[-3000:]}")
+        if code in abort_codes:
+            # abort() runs no Python: the faulthandler C handler is
+            # the guaranteed forensic layer, and it must have written
+            # THIS survivor's every-thread stack dump.
+            stack_path = os.path.join(
+                logdir, f"stacks.sigabrt.{pids[index]}.txt")
+            assert os.path.exists(stack_path), sorted(
+                os.listdir(logdir))
+            assert os.path.getsize(stack_path) > 0, stack_path
+            assert "Thread" in open(stack_path).read()
+        else:
+            # The kv_unreachable verdict path owns the ring dump.
+            dumps = [p for p in glob.glob(os.path.join(
+                logdir, "flightrec.*.json"))
+                if json.load(open(p)).get("pid") == pids[index]]
+            assert dumps and all(
+                json.load(open(p))["reason"].startswith("fleet:")
+                for p in dumps), dumps
+
+
 def test_sigterm_grace_checkpoint_and_frame_exact_resume(tmp_path):
     """SIGTERM one peer of a training fleet: the KV flag + broadcast
     verdict commit EVERY process to the same drain point; all exit 0
