@@ -46,22 +46,14 @@ PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
 # loosening the guarantee.)
 TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "1400"))
 
-# Peak bf16 matmul FLOP/s per chip, by jax device_kind prefix.
-_PEAK_FLOPS = [
-    ("TPU v6", 918e12),
-    ("TPU v5p", 459e12),
-    ("TPU v5", 197e12),  # v5e / "TPU v5 lite"
-    ("TPU v4", 275e12),
-    ("TPU v3", 123e12),
-    ("TPU v2", 46e12),
-]
-
-
 def _peak_flops(device_kind: str):
-    for prefix, peak in _PEAK_FLOPS:
-        if device_kind.startswith(prefix):
-            return peak
-    return None
+    """Peak bf16 matmul FLOP/s per chip.  The table itself lives in
+    obs/ledger.py (``PEAK_FLOPS``) so the bench's MFU headline and the
+    driver's live ``ledger/mfu`` gauge share one roofline denominator
+    (the import is jax-free and safe pre-backend-probe)."""
+    from scalable_agent_tpu.obs.ledger import peak_flops_per_chip
+
+    return peak_flops_per_chip(device_kind)
 
 
 def _core_impl() -> str:
@@ -1117,6 +1109,80 @@ def bench_obs(diag):
             failure_layer_s / sec_per_update, 5)
 
 
+def bench_ledger(diag):
+    """Pipeline-ledger overhead (ISSUE 8 acceptance: <2% of the update
+    stage).  Times the unit costs of what the ledger puts near the hot
+    path — a lock-free ``stamp`` (one record-dict store + one atomic
+    ring append), a full record lifecycle (open + the ~8 stamps a
+    trajectory collects + close), a queue-edge ``bind``/``lookup``
+    pair, and the per-record derivation cost of ``publish`` — and
+    amortizes them onto the update stage at their REAL cadence: one
+    record lifecycle + 2 bind/lookup pairs per update (one trajectory
+    feeds one update), derivation amortized per closed record.  All
+    per-TRAJECTORY costs (thousands of env frames each), nothing per
+    env step.  Pure host timing, <1s, backend-independent — the
+    ``bench_obs`` pattern."""
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.obs.ledger import PipelineLedger
+
+    registry = MetricsRegistry()
+    ledger = PipelineLedger(registry=registry,
+                            frames_per_trajectory=12800)
+    n = 20000
+
+    def per_call_us(fn, iters=n):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    anchor = ledger.open("bench-actor", "bench")
+    diag["ledger_stamp_us"] = round(
+        per_call_us(lambda: ledger.stamp(anchor, "dispatch")), 3)
+    ledger.close(anchor, retired=True)
+
+    stages = ("unroll_done", "queue_put", "queue_get", "transport_pack",
+              "transport_upload", "transport_unpack", "put_done",
+              "dispatch")
+
+    def lifecycle():
+        tid = ledger.open("bench-actor", "bench")
+        for stage in stages:
+            ledger.stamp(tid, stage)
+        ledger.stamp(tid, "retire")
+        ledger.close(tid, retired=True)
+
+    diag["ledger_record_lifecycle_us"] = round(
+        per_call_us(lifecycle, iters=5000), 3)
+
+    def bind_lookup():
+        ledger.bind(12345, 1)
+        ledger.lookup(12345)
+
+    diag["ledger_bind_lookup_us"] = round(per_call_us(bind_lookup), 3)
+
+    # Derivation cost per closed record: fill one publish window, time
+    # the publish, divide.  (publish runs at log-interval cadence on
+    # the logging thread; per-record is the honest amortization.)
+    m = 2000
+    for _ in range(m):
+        lifecycle()
+    t0 = time.perf_counter()
+    stats = ledger.publish(interval_s=10.0)
+    publish_s = time.perf_counter() - t0
+    assert stats["records"] >= m  # the window actually held them
+    diag["ledger_publish_us_per_record"] = round(publish_s / m * 1e6, 3)
+
+    sec_per_update = diag.get("sec_per_update")
+    if sec_per_update:
+        per_update_s = (
+            diag["ledger_record_lifecycle_us"]
+            + 2 * diag["ledger_bind_lookup_us"]
+            + diag["ledger_publish_us_per_record"]) / 1e6
+        diag["ledger_overhead_frac_on_update"] = round(
+            per_update_s / sec_per_update, 6)
+
+
 def bench_transport(diag, budget_s=150.0):
     """Trajectory-transport stage (ISSUE 3): packed single-copy H2D vs
     the per-leaf ``device_put`` storm at the production trajectory
@@ -1602,6 +1668,55 @@ def fleet_regression_guard(diag):
             diag["errors"].append(msg)
 
 
+# The pipeline ledger's budget on the update stage (ISSUE 8
+# acceptance): stamp + derive costs, amortized per update, must stay
+# inside the same <2% envelope as the rest of the obs layer.
+LEDGER_BUDGET_FRAC = 0.02
+
+# The ledger keys bench_ledger publishes (obs-guard-style missing-key
+# protection: a key the previous round had must not silently vanish).
+LEDGER_GUARD_KEYS = (
+    "ledger_overhead_frac_on_update",
+    "ledger_stamp_us",
+    "ledger_record_lifecycle_us",
+    "ledger_bind_lookup_us",
+    "ledger_publish_us_per_record",
+)
+
+
+def ledger_regression_guard(diag, bench_dir=None):
+    """ISSUE 8 acceptance: fail the bench when the pipeline ledger
+    (record lifecycle + hand-off bindings + derivation, amortized per
+    update) exceeds 2% of the update stage — binding on TPU, advisory
+    on the CPU fallback where the tiny sec_per_update makes the ratio
+    jitter-bound (the fleet/resilience guard discipline).  Also
+    obs-guard-style: a ledger key the previous round's artifact
+    published that this round didn't is always an error."""
+    frac = diag.get("ledger_overhead_frac_on_update")
+    if frac is not None and frac > LEDGER_BUDGET_FRAC:
+        msg = (
+            f"LEDGER: pipeline-ledger overhead {frac:.3%} of the "
+            f"update stage exceeds the {LEDGER_BUDGET_FRAC:.0%} budget "
+            f"(lifecycle {diag.get('ledger_record_lifecycle_us')}us, "
+            f"bind/lookup {diag.get('ledger_bind_lookup_us')}us, "
+            f"publish/record "
+            f"{diag.get('ledger_publish_us_per_record')}us)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory, the tiny "
+                "sec_per_update makes the ratio jitter-bound")
+        else:
+            diag["errors"].append(msg)
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in LEDGER_GUARD_KEYS:
+        if prev.get(key) and diag.get(key) is None:
+            diag["errors"].append(
+                f"LEDGER REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
+
+
 # The supervisor's steady-state budget (ISSUE 6 acceptance): its watch
 # cycle amortized at the poll cadence must stay under 0.5% of wall
 # time (= of the update stage when the device is saturated).
@@ -2055,6 +2170,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_obs failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "bench_ledger"
+    try:
+        bench_ledger(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_ledger failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "bench_transport"
     try:
         bench_transport(
@@ -2104,6 +2225,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "obs regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "ledger_regression_guard"
+    try:
+        ledger_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "ledger regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "transport_regression_guard"
     try:

@@ -89,9 +89,11 @@ from scalable_agent_tpu.obs import (
     PrometheusExporter,
     StallAttributor,
     configure_flight_recorder,
+    configure_ledger,
     configure_tracer,
     configure_watchdog,
     get_flight_recorder,
+    get_ledger,
     get_registry,
     get_tracer,
     get_watchdog,
@@ -232,9 +234,10 @@ def probe_env(config: Config):
 
 
 def zero_trajectory(config: Config, observation_spec, agent: ImpalaAgent,
-                    batch: int = 1) -> Trajectory:
-    """All-zeros [2, batch] trajectory for shape-only initialization."""
-    t_plus_1 = 2
+                    batch: int = 1, t_plus_1: int = 2) -> Trajectory:
+    """All-zeros [t_plus_1, batch] trajectory for shape-only use: the
+    [2, 1] default initializes params; the live-MFU cost analysis lowers
+    the update at the run's REAL [T+1, B] shape."""
     frame_spec = observation_spec.frame
 
     def zeros(shape, dtype):
@@ -417,6 +420,13 @@ def start_prefetch(pool, learner, staged: queue_lib.Queue,
                 except queue_lib.Empty:
                     continue
                 traj = learner.put_trajectory(to_trajectory(out))
+                # Re-bind the provenance record (this thread's current,
+                # set by get_trajectory) to the PLACED object the main
+                # loop will pull off the staged queue.
+                ledger = get_ledger()
+                tid = ledger.current()
+                if tid is not None:
+                    ledger.bind(id(traj), tid)
                 while not stop.is_set():
                     watchdog.touch()
                     try:
@@ -445,6 +455,45 @@ def _host_scalar(x) -> float:
     if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
         return float(np.asarray(x.addressable_shards[0].data))
     return float(np.asarray(x))
+
+
+def _configure_live_mfu(ledger, lower_fn, num_devices: int):
+    """Arm the ledger's live ``ledger/mfu`` gauge (obs/ledger.py).
+
+    FLOPs per update come from the LOWERED (uncompiled) update
+    program's cost analysis — tracing cost only, a few seconds at
+    startup, no second XLA compile — and the per-chip peak from the
+    shared roofline table in obs/ledger.py (the same one bench.py's MFU
+    uses, so a run's gauge and the bench headline share a denominator).
+    Skipped when the chip's peak is unknown (the CPU fallback — the
+    gauge then stays at 0, and no test pays the lowering); the
+    SCALABLE_AGENT_LEDGER_MFU_PEAK env var overrides the peak so the
+    full path is exercisable anywhere."""
+    from scalable_agent_tpu.obs.ledger import peak_flops_per_chip
+
+    peak = peak_flops_per_chip(jax.local_devices()[0].device_kind)
+    override = os.environ.get("SCALABLE_AGENT_LEDGER_MFU_PEAK")
+    if override:
+        try:
+            peak = float(override)
+        except ValueError:
+            pass
+    if not peak:
+        return
+    try:
+        cost = lower_fn().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float((cost or {}).get("flops", 0.0))
+    except Exception as exc:  # an obs gauge must never kill training
+        log.info("live MFU gauge disabled (cost analysis failed): %s",
+                 exc)
+        return
+    if flops > 0:
+        ledger.configure_mfu(flops, peak, num_devices)
+        log.info("live MFU gauge armed: %.3g flops/update against "
+                 "%.3g peak flops/s x %d device(s)",
+                 flops, peak, num_devices)
 
 
 @dataclasses.dataclass
@@ -660,6 +709,16 @@ def train(config: Config) -> Dict[str, float]:
         recorder=get_flight_recorder(),
         epoch=config.fleet_epoch,
         logdir=config.logdir)
+    # Pipeline ledger (obs/ledger.py): per-trajectory provenance
+    # records stamped at every stage boundary below, derived into
+    # per-stage rates/ρ, the staleness histogram, and the live MFU
+    # gauge at each log interval.  Configured fresh per run so one
+    # run's open records can never leak into the next.
+    ledger = configure_ledger(
+        registry=registry,
+        frames_per_trajectory=config.frames_per_update(),
+        logdir=config.logdir,
+        process_index=jax.process_index())
     pool = prefetch_thread = writer = ckpt = None
     prefetch_stop = threading.Event()
     profiling = False
@@ -716,6 +775,22 @@ def train(config: Config) -> Dict[str, float]:
                      start_updates, _host_scalar(state.env_frames))
         else:
             start_updates = 0
+
+        # Live MFU numerator: lower (don't compile) the update once at
+        # the run's REAL [T+1, local_B] shape for its cost-analysis
+        # FLOPs.  The denominator is this PROCESS'S share of the mesh
+        # (local devices), matching the local-batch numerator — each
+        # process then gauges its own chips' utilization, and the
+        # aggregator's MAX fold shows the busiest process.  No-op on
+        # chips without a roofline entry (CPU).
+        mfu_example = zero_trajectory(
+            config, observation_spec, agent,
+            batch=max(1, config.batch_size // jax.process_count()),
+            t_plus_1=config.unroll_length + 1)
+        _configure_live_mfu(
+            ledger, lambda: learner._update.lower(state, mfu_example),
+            max(1, learner.mesh.devices.size // jax.process_count()))
+        del mfu_example
 
         env_groups = make_env_groups(config, observation_spec.frame,
                                      num_agents=num_agents,
@@ -823,9 +898,15 @@ def train(config: Config) -> Dict[str, float]:
             watchdog.touch("learner")
             if isinstance(traj, Exception):
                 raise traj
+            # Recover the batch's provenance record; the in-flight
+            # window owns its end (retire stamps + close, or the
+            # rollback discard's retired=False close).
+            ledger_tid = ledger.lookup(id(traj))
             with timing.time_avg("update"), interval.add_time("update"):
                 state, dispatched = learner.update(state, traj)
-            inflight.push(dispatched)
+            if ledger_tid is not None:
+                ledger.stamp(ledger_tid, "dispatch")
+            inflight.push(dispatched, ledger_id=ledger_tid)
             if cpu_lockstep:
                 # Materialize the WHOLE update before the loop can
                 # reach another cross-process point (decision
@@ -931,6 +1012,11 @@ def train(config: Config) -> Dict[str, float]:
                 timing_summary = timing.summary()
                 host_metrics.update(
                     {f"timing/{k}": v for k, v in timing_summary.items()})
+                # Ledger derivation BEFORE stall attribution, so the
+                # verdict line carries this interval's dominant-stage
+                # share (rates/ρ/staleness/MFU land in the registry and
+                # ride the writer/prom dumps below).
+                ledger.publish()
                 # Stall attribution over THIS interval's stage sums.
                 interval_summary = interval.summary()
                 interval.clear()
@@ -1060,6 +1146,15 @@ def train(config: Config) -> Dict[str, float]:
             pool.stop()
         if prefetch_thread is not None:
             prefetch_thread.join(timeout=5)
+        # Ledger finalize AFTER the pipeline threads stopped (no new
+        # stamps) and BEFORE the obs teardown's final prom dump, so the
+        # snapshot shows the swept state: in-pipeline records closed as
+        # abandoned, zero open records on a clean exit, last derivation
+        # published, ledger.p<proc>.json on disk.
+        try:
+            get_ledger().finalize()
+        except Exception:
+            log.exception("ledger finalize failed")
         if writer is not None:
             writer.close()
         if ckpt is not None:
@@ -1236,6 +1331,20 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         recorder=get_flight_recorder(),
         epoch=config.fleet_epoch,
         logdir=config.logdir)
+    # Ledger in the fused backend: there is no host pipeline to stamp —
+    # each update opens a degenerate record (birth = dispatch, closed
+    # retired on materialization order), which keeps the update-cadence
+    # accounting, the retire counters, and the live MFU gauge alive
+    # with the same names as the host backend.
+    ledger = configure_ledger(
+        registry=registry,
+        frames_per_trajectory=config.frames_per_update(),
+        logdir=config.logdir,
+        process_index=0)
+    _configure_live_mfu(
+        ledger,
+        lambda: trainer.train_step.lower(state, carry, np.int32(0)),
+        learner.mesh.devices.size)
     if restored is not None:
         fleet.note_checkpoint(start_updates)
     watchdog = get_watchdog()
@@ -1248,6 +1357,8 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         # loop (or checkpointing) raises.
         with MetricsWriter(config.logdir, registry=registry) as writer:
             while frames < config.total_environment_frames:
+                ledger_tid = ledger.open("ingraph",
+                                         config.level_name)
                 with timing.time_avg("update"), \
                         get_tracer().span("learner/train_step",
                                           cat="learner"):
@@ -1257,11 +1368,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # have used.
                     state, carry, metrics = trainer.train_step(
                         state, carry, np.int32(updates))
+                ledger.stamp(ledger_tid, "dispatch")
+                ledger.close(ledger_tid, retired=True)
                 watchdog.touch("learner")
                 updates += 1
                 frames += frames_per_update
                 now = time.monotonic()
                 if now - last_log >= config.log_interval_s:
+                    ledger.publish()
                     host_metrics = _finalize_ingraph_metrics(
                         metrics, config)
                     if nonfinite.observe(host_metrics):
@@ -1319,6 +1433,10 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             fleet.note_fatal_error(_exc)
         configure_watchdog(None)  # same teardown-tail disarm as train()
         configure_faults("")
+        try:
+            get_ledger().finalize()
+        except Exception:
+            log.exception("ledger finalize failed")
         ckpt.close()
         _teardown_observability(config, obs_handles)
         configure_fleet(None)  # after obs: covers the whole tail

@@ -30,6 +30,11 @@ from scalable_agent_tpu.obs.flightrec import (
     get_flight_recorder,
     install_crash_handlers,
 )
+from scalable_agent_tpu.obs.ledger import (
+    PipelineLedger,
+    configure_ledger,
+    get_ledger,
+)
 from scalable_agent_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -60,14 +65,17 @@ __all__ = [
     "MetricsHTTPServer",
     "MetricsRegistry",
     "MetricsWriter",
+    "PipelineLedger",
     "PrometheusExporter",
     "StallAttributor",
     "Tracer",
     "Watchdog",
     "configure_flight_recorder",
+    "configure_ledger",
     "configure_tracer",
     "configure_watchdog",
     "get_flight_recorder",
+    "get_ledger",
     "get_registry",
     "get_tracer",
     "get_watchdog",

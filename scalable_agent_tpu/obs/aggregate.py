@@ -20,6 +20,10 @@ This module (and its CLI) folds them:
   gauges SUM by default but depth/memory-style gauges take the MAX
   (worst queue) and occupancy-style gauges the MIN (most-starved
   consumer); summary quantiles take the MAX (worst-case latency).
+  Pipeline-ledger series (obs/ledger.py) fold the same way the
+  questions read: per-stage rates SUM to fleet throughput, ρ/latency
+  shares/MFU take the busiest process (MAX), and the staleness
+  quantiles ride the worst-case quantile rule (MAX).
 
 CLI::
 
@@ -223,6 +227,16 @@ def _fleet_fold(family: str, metric: str, kind: str,
         return "sum"
     if "peers_alive" in metric:
         return "min"
+    # Pipeline ledger (obs/ledger.py): per-stage rates are per-process
+    # throughputs (counters in spirit — they SUM to the fleet rate,
+    # the default below), but utilization/occupancy ρ, latency shares,
+    # MFU, and the truncation flag answer "what does the worst/busiest
+    # process look like" — MAX.  Staleness quantiles take the generic
+    # worst-case quantile rule further down.
+    if "ledger" in metric and ("_rho_" in metric or "latency_share"
+                               in metric or metric.endswith("_mfu")
+                               or metric.endswith("_truncated")):
+        return "max"
     # Elastic membership (runtime/elastic.py): the epoch gauge is a
     # fleet-wide cursor — mid-relaunch, a straggler's stale snapshot
     # still shows the OLD epoch, and summing epochs is meaningless;

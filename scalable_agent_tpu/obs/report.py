@@ -1,0 +1,271 @@
+"""The pipeline gap report: the document a human reads before writing
+the next perf PR.
+
+::
+
+    python -m scalable_agent_tpu.obs.report <logdir>
+
+renders, from a run's on-disk artifacts (``metrics*.prom``,
+``ledger.p*.json`` — no jax, run it on a laptop against rsync'd files):
+
+- the **stage table**: per ledger segment (obs/ledger.py SEGMENTS), the
+  arrival rate, mean/p95 latency, occupancy ρ (Little's-law L for wait
+  stages), and its share of mean birth→retire frame latency;
+- the **staleness histogram** (``ledger/staleness_s`` p50/p95/p99 —
+  frame age at consumption, ROADMAP item 2's metric);
+- the **live MFU** gauge and actor-vs-learner FPS;
+- the stall verdict and a **top recommendation** keyed on the
+  dominant-latency stage — the same attribution the verdict log line
+  carries, expanded into the concrete next fix.
+
+Multi-process logdirs are folded on the fly with obs/aggregate.py's
+fold rules (rates sum, ρ max, staleness quantiles max) when
+``metrics.fleet.prom`` is absent, so the report always covers the whole
+fleet.
+"""
+
+import argparse
+import glob
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+from scalable_agent_tpu.obs.aggregate import (
+    FLEET_PROM_NAME,
+    aggregate_prometheus,
+    find_artifacts,
+    parse_prometheus,
+)
+from scalable_agent_tpu.obs.exporters import _prom_name
+from scalable_agent_tpu.obs.ledger import (
+    SEGMENT_LABELS,
+    SEGMENTS,
+    SERVICE_STAGES,
+)
+
+__all__ = ["main", "render_report"]
+
+# Dominant-latency stage -> the concrete next fix.  This is the
+# queueing-model reading of BENCH_r04's 200x gap: name the stage that
+# holds the frames, then act on that stage (ROADMAP items 1-2).
+RECOMMENDATIONS = {
+    "unroll": (
+        "the actor side (env stepping + inference) holds the frames: "
+        "scale env workers/groups, use inference_mode=accum/accum_fused "
+        "to collapse per-step link traffic, or move rollouts on-device "
+        "(ROADMAP item 1: device-resident rollouts)"),
+    "backpressure": (
+        "actors block on a full trajectory queue: the learner side "
+        "consumes slower than actors produce — read the device/"
+        "transport rows; if those are idle, raise queue capacity"),
+    "queue_wait": (
+        "trajectories sit in the batcher (trajectory queue) waiting "
+        "for the prefetch/transport stage: speed up put_trajectory "
+        "(--transport=packed, runtime/linktune.py) or add prefetch "
+        "depth"),
+    "transport": (
+        "host->device transport dominates: --transport=packed, check "
+        "transport/h2d_bytes_total against the probed link bandwidth "
+        "(runtime/linktune.py), or eliminate the upload entirely with "
+        "device-resident rollouts (ROADMAP item 1)"),
+    "staged_wait": (
+        "staged batches wait on a busy learner — the device is the "
+        "constraint (healthy); raise --inflight_updates or feed a "
+        "bigger batch"),
+    "device": (
+        "device execution dominates — the pipeline is healthy and the "
+        "chip is the constraint: faster kernels (core_impl=pallas, "
+        "bf16), larger batch, bigger mesh"),
+    "inference_service": (
+        "the dynamic-batching inference service saturates: more "
+        "consumers, larger max batch, or accum-mode actors"),
+}
+
+
+def _load_families(logdir: str) -> Tuple[Dict[str, dict], str]:
+    """Parsed prometheus families for the logdir, folding multi-process
+    snapshots on the fly; returns (families, source description)."""
+    fleet_path = os.path.join(logdir, FLEET_PROM_NAME)
+    if os.path.exists(fleet_path):
+        return (parse_prometheus(open(fleet_path).read()),
+                FLEET_PROM_NAME)
+    _, proms = find_artifacts(logdir)
+    if not proms:
+        raise FileNotFoundError(
+            f"no metrics*.prom under {logdir} — run the driver with a "
+            f"logdir (the snapshot is always on) or aggregate first")
+    if len(proms) == 1:
+        (label, path), = proms.items()
+        return (parse_prometheus(open(path).read()),
+                os.path.basename(path))
+    texts = {label: open(path).read() for label, path in proms.items()}
+    return (parse_prometheus(aggregate_prometheus(texts)),
+            f"{len(proms)} snapshots (folded)")
+
+
+def _value(families: Dict[str, dict], registry_name: str,
+           quantile: Optional[str] = None,
+           suffix: str = "") -> Optional[float]:
+    """One series value by REGISTRY name (prom sanitization applied
+    here).  Fleet-folded families hold both per-process and fold-
+    labelled series — the fold one (the fleet total) wins; a plain
+    single-process snapshot has exactly the unlabelled series."""
+    family = _prom_name(registry_name)
+    data = families.get(family)
+    if data is None:
+        return None
+    metric = family + suffix
+    want_q = quantile
+    best = None
+    for (name, labels), value in data["series"].items():
+        if name != metric:
+            continue
+        ldict = dict(labels)
+        if want_q is not None and ldict.get("quantile") != want_q:
+            continue
+        if want_q is None and "quantile" in ldict:
+            continue
+        if "fold" in ldict:
+            return value  # fleet total: authoritative
+        if "process" not in ldict:
+            best = value  # plain snapshot series
+        elif best is None:
+            best = value  # fall back to any per-process series
+    return best
+
+
+def _ledger_artifacts(logdir: str) -> list:
+    out = []
+    for path in sorted(glob.glob(os.path.join(logdir, "ledger.p*.json"))):
+        try:
+            out.append(json.load(open(path)))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _fmt(value: Optional[float], spec: str = "8.3f") -> str:
+    if value is None:
+        width = spec.split(".")[0]
+        return " " * (int(width) - 1 if width else 0) + "-"
+    return format(value, spec)
+
+
+def render_report(logdir: str) -> str:
+    families, source = _load_families(logdir)
+    lines = [f"Pipeline ledger report — {logdir}",
+             f"source: {source}", ""]
+
+    header = (f"{'stage':<18}{'rate/s':>9}{'mean_s':>10}{'p95_s':>10}"
+              f"{'rho(L)':>9}{'share':>8}  where")
+    lines.append(header)
+    lines.append("-" * len(header))
+    shares = {}
+    for name, _, _ in SEGMENTS:
+        rate = _value(families, f"ledger/rate/{name}_per_s")
+        rho = _value(families, f"ledger/rho/{name}")
+        share = _value(families, f"ledger/latency_share/{name}")
+        total = _value(families, f"ledger/stage/{name}_s", suffix="_sum")
+        count = _value(families, f"ledger/stage/{name}_s",
+                       suffix="_count")
+        mean = (total / count) if total is not None and count else None
+        p95 = _value(families, f"ledger/stage/{name}_s", quantile="0.95")
+        if share is not None:
+            shares[name] = share
+        lines.append(
+            f"{name:<18}{_fmt(rate, '9.2f')}{_fmt(mean, '10.4f')}"
+            f"{_fmt(p95, '10.4f')}{_fmt(rho, '9.3f')}"
+            f"{_fmt(share * 100 if share is not None else None, '7.1f')}%"
+            f"  {SEGMENT_LABELS[name]}")
+    for name in SERVICE_STAGES:
+        rate = _value(families, f"ledger/rate/{name}_per_s")
+        rho = _value(families, f"ledger/rho/{name}")
+        if not rate and not rho:
+            continue
+        lines.append(
+            f"{name:<18}{_fmt(rate, '9.2f')}{'-':>10}{'-':>10}"
+            f"{_fmt(rho, '9.3f')}{'-':>7}   {SEGMENT_LABELS[name]}")
+    lines.append("")
+
+    staleness = {q: _value(families, "ledger/staleness_s", quantile=q)
+                 for q in ("0.5", "0.95", "0.99")}
+    if any(v is not None for v in staleness.values()):
+        labels = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}
+        lines.append(
+            "staleness (frame age at consumption): "
+            + "  ".join(f"{labels[q]} {_fmt(staleness[q], '.3f')}s"
+                        for q in ("0.5", "0.95", "0.99")))
+    mfu = _value(families, "ledger/mfu")
+    learner_fps = _value(families, "learner/fps")
+    actor_fps = _value(families, "actor/fps")
+    lines.append(
+        f"mfu: {_fmt(mfu, '.4g') if mfu is not None else 'n/a'}   "
+        f"learner fps: {_fmt(learner_fps, '.0f')}   "
+        f"actor fps: {_fmt(actor_fps, '.0f')}")
+
+    opened = _value(families, "ledger/trajectories_opened_total")
+    retired = _value(families, "ledger/trajectories_retired_total")
+    discarded = _value(families, "ledger/frames_discarded_total")
+    open_now = _value(families, "ledger/open_records")
+    lines.append(
+        f"trajectories: {_fmt(opened, '.0f')} opened, "
+        f"{_fmt(retired, '.0f')} retired, "
+        f"{_fmt(discarded, '.0f')} frames discarded, "
+        f"{_fmt(open_now, '.0f')} open")
+
+    verdict = None
+    for category in ("device_bound", "env_bound", "learner_starved",
+                     "stalled_thread"):
+        flag = _value(families, f"stall/is_{category}")
+        if flag == 1.0:
+            verdict = category
+    if verdict:
+        lines.append(f"stall verdict: {verdict}")
+
+    if shares:
+        dominant = max(shares, key=shares.get)
+        lines.append(
+            f"dominant stage: {dominant} "
+            f"({shares[dominant]:.0%} of frame latency in "
+            f"{SEGMENT_LABELS[dominant]})")
+        lines.append(
+            "top recommendation: "
+            + RECOMMENDATIONS.get(dominant, "inspect the stage table"))
+    else:
+        lines.append(
+            "dominant stage: n/a (no closed ledger records published — "
+            "did the run retire any updates?)")
+
+    ledgers = _ledger_artifacts(logdir)
+    for artifact in ledgers:
+        extra = ""
+        if artifact.get("ring_truncated") or any(
+                artifact.get("counters", {}).get(k)
+                for k in ("dropped",)):
+            extra = " [TRUNCATED window]"
+        lines.append(
+            f"ledger artifact p{artifact.get('process_index')}: "
+            f"{artifact.get('counters', {}).get('opened', 0):.0f} "
+            f"records, "
+            f"{artifact.get('counters', {}).get('abandoned', 0):.0f} "
+            f"abandoned at shutdown{extra}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render the pipeline-ledger gap report (stage "
+                    "table, staleness, MFU, top recommendation) from a "
+                    "run logdir's prom/ledger artifacts.  jax-free.")
+    parser.add_argument("logdir", help="run log directory")
+    args = parser.parse_args(argv)
+    try:
+        print(render_report(args.logdir), end="")
+    except FileNotFoundError as exc:
+        print(str(exc))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

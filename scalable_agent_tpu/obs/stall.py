@@ -29,6 +29,13 @@ Inputs are the driver's per-interval wait/update seconds plus the
 actor-side env/inference histograms the runtime already feeds into the
 registry (the attributor tracks their cumulative sums and differences
 them per interval, so actor threads never synchronize with it).
+
+When the pipeline ledger (obs/ledger.py) has published latency shares,
+the verdict additionally carries the **dominant-stage attribution** —
+"learner_starved (…; 78% of frame latency in batcher wait)" — naming
+the exact segment of the actor→queue→transport→learner path that holds
+the frames, so the coarse verdict and the queueing-model decomposition
+read as one line.
 """
 
 from typing import Dict, Optional, Tuple
@@ -130,13 +137,30 @@ class StallAttributor:
         for name, gauge in self._category_gauges.items():
             gauge.set(1.0 if name == category else 0.0)
         self._category_counters[category].inc()
-        return category, {
+        evidence = {
             "wait_frac": wait_frac,
             "retire_frac": retire_frac,
             "actor_env_frac": env_frac,
             "actor_env_s": env_s,
             "actor_infer_s": infer_s,
         }
+        # Ledger dominant-stage attribution (re-read per call: the
+        # driver reconfigures the global ledger per run).  Gated on the
+        # ledger sharing THIS attributor's registry — the two views
+        # must describe the same metrics plane, and an attributor built
+        # against a private registry (tests, ad-hoc tooling) must not
+        # inherit another run's ledger verdict.  Shares publish only
+        # from intervals with closed records, so the attribution is
+        # absent — not stale — before the first trajectory retires.
+        from scalable_agent_tpu.obs.ledger import get_ledger
+
+        ledger = get_ledger()
+        if ledger.registry is self._registry:
+            dominant = ledger.dominant_segment()
+            if dominant is not None:
+                evidence["ledger_dominant"] = dominant[0]
+                evidence["ledger_dominant_share"] = dominant[1]
+        return category, evidence
 
     def report_stalled(self, stalled: Dict[str, float],
                        count: bool = True) -> str:
@@ -160,11 +184,22 @@ class StallAttributor:
 
     @staticmethod
     def describe(category: str, fractions: Dict[str, float]) -> str:
-        """One log line: verdict + the numbers that justify it."""
+        """One log line: verdict + the numbers that justify it (plus
+        the ledger's dominant-stage attribution when available)."""
         retire = fractions.get("retire_frac", 0.0)
         retire_part = (f"; inflight retire {retire:.0%}"
                        if retire else "")
+        ledger_part = ""
+        dominant = fractions.get("ledger_dominant")
+        if dominant:
+            from scalable_agent_tpu.obs.ledger import SEGMENT_LABELS
+
+            share = fractions.get("ledger_dominant_share", 0.0)
+            ledger_part = (
+                f"; {share:.0%} of frame latency in "
+                f"{SEGMENT_LABELS.get(dominant, dominant)}")
         return (f"pipeline {category} "
                 f"(wait_batch {fractions['wait_frac']:.0%} of learner "
                 f"interval; actor env share "
-                f"{fractions['actor_env_frac']:.0%}{retire_part})")
+                f"{fractions['actor_env_frac']:.0%}{retire_part}"
+                f"{ledger_part})")

@@ -38,6 +38,7 @@ import numpy as np
 
 from scalable_agent_tpu.envs.vector import MultiEnv
 from scalable_agent_tpu.obs import get_tracer, get_watchdog
+from scalable_agent_tpu.obs.ledger import now_us as ledger_now_us
 from scalable_agent_tpu.models.agent import (
     ImpalaAgent,
     actor_step,
@@ -309,6 +310,9 @@ class AccumVectorActor:
         return fields
 
     def run_unroll(self, params) -> ActorOutput:
+        # Ledger birth (obs/ledger.py): same contract as VectorActor —
+        # the pool opens this unroll's provenance record at this stamp.
+        self.unroll_birth_us = ledger_now_us()
         p = self._p
         if self._bufs is None:
             self._last_env_host = self._envs.initial()
@@ -447,6 +451,9 @@ class GroupedAccumActor:
     def run_unroll(self, params):
         """One lockstep unroll -> list of k ActorOutputs (one per
         group, each [T+1, B] on device)."""
+        # One birth stamp for the whole lockstep unroll: all k groups'
+        # trajectories share it (the pool opens k records from it).
+        self.unroll_birth_us = ledger_now_us()
         p = self._p
         k = len(self.envs_list)
         if self._bufs is None:

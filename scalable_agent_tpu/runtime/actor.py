@@ -38,10 +38,12 @@ from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step, initial_sta
 from scalable_agent_tpu.envs.vector import MultiEnv
 from scalable_agent_tpu.obs import (
     get_flight_recorder,
+    get_ledger,
     get_registry,
     get_tracer,
     get_watchdog,
 )
+from scalable_agent_tpu.obs.ledger import now_us as ledger_now_us
 from scalable_agent_tpu.types import (
     ActorOutput,
     AgentOutput,
@@ -137,6 +139,11 @@ class VectorActor:
 
     def run_unroll(self, params) -> ActorOutput:
         """Generate one [T+1, B] trajectory batch under ``params``."""
+        # Ledger birth stamp (obs/ledger.py): the moment this unroll's
+        # first env step happens — the age every downstream staleness/
+        # latency number is measured from.  The pool reads it when it
+        # opens the trajectory's provenance record.
+        self.unroll_birth_us = ledger_now_us()
         if self._last_env_output is None:
             self._bootstrap(params)
 
@@ -564,7 +571,18 @@ class ActorPool:
             items = result if isinstance(result, list) else [result]
             recorder.record("unroll", actor.level_name or "actor",
                             {"trajectories": len(items)})
+            ledger = get_ledger()
+            thread_name = threading.current_thread().name
+            birth_us = getattr(actor, "unroll_birth_us", None)
             for trajectory in items:
+                # Provenance record: born at the unroll's first env
+                # step, bound to the trajectory OBJECT so the consumer
+                # recovers the id regardless of producer interleaving.
+                tid = ledger.open(thread_name,
+                                  actor.level_name or "actor",
+                                  birth_us=birth_us)
+                ledger.stamp(tid, "unroll_done")
+                ledger.bind(id(trajectory), tid)
                 delivered = False
                 with tracer.span("batcher/queue_put", cat="queue"):
                     while not self._stop.is_set():
@@ -576,10 +594,17 @@ class ActorPool:
                         except queue_lib.Full:
                             continue
                 if delivered:  # shutdown can abandon the put
+                    ledger.stamp(tid, "queue_put")
                     recorder.record("queue", "put")
                     self._trajectories_counter.inc()
                     self._frames_counter.inc(
                         self._frames_per_trajectory)
+                else:
+                    # Shutdown caught the hand-off: the record must not
+                    # leak open (and its binding must not alias a later
+                    # object at the same address).
+                    ledger.unbind(id(trajectory))
+                    ledger.close(tid, retired=False, fate="abandoned")
 
     def _actor_loop(self, actor: VectorActor):
         """Retry shell around ``_unroll_loop``: a failing actor thread
@@ -678,6 +703,14 @@ class ActorPool:
         get_flight_recorder().record("queue", "get")
         if isinstance(item, Exception):
             raise item
+        # Ledger hand-off: recover the provenance record bound to this
+        # object and make it the consuming thread's CURRENT record, so
+        # the transport/learner layers downstream stamp the right one.
+        ledger = get_ledger()
+        tid = ledger.lookup(id(item))
+        if tid is not None:
+            ledger.stamp(tid, "queue_get")
+        ledger.set_current(tid)
         return item
 
     def stop(self):

@@ -38,6 +38,7 @@ import numpy as np
 
 from scalable_agent_tpu.obs import (
     get_flight_recorder,
+    get_ledger,
     get_registry,
     get_tracer,
     get_watchdog,
@@ -210,6 +211,7 @@ class DynamicBatcher:
         self._occupancy_hist.observe(n / self._max)
         self._batches_total.inc()
         try:
+            started_at = time.monotonic()
             with get_tracer().span("batcher/run_batch",
                                    args={"n": n, "padded": padded}):
                 stacked = map_structure(
@@ -218,6 +220,11 @@ class DynamicBatcher:
                 result = self._compute_fn(stacked, n)
                 rows = _unstack(result, n)
             done_at = time.monotonic()
+            # Ledger service stage (obs/ledger.py): arrivals + busy
+            # seconds per executed batch feed the inference service's
+            # queueing-model utilization ρ.
+            get_ledger().note_service(
+                "inference_service", n, done_at - started_at)
             for request, row in zip(batch, rows):
                 self._latency_hist.observe(done_at - request.enqueued_at)
                 request.future.set_result(row)

@@ -35,6 +35,7 @@ import optax
 from scalable_agent_tpu.models.agent import ImpalaAgent
 from scalable_agent_tpu.obs import (
     get_flight_recorder,
+    get_ledger,
     get_registry,
     get_tracer,
 )
@@ -311,6 +312,10 @@ class Learner:
                 self._h_put.time(), \
                 get_fleet().collective("put_trajectory"):
             result = self._transport.put(trajectory)
+        # Ledger stage boundary: device placement complete for the
+        # calling thread's current trajectory record (the packed path
+        # additionally stamped pack/upload/unpack inside put()).
+        get_ledger().stamp_current("put_done")
         get_flight_recorder().record("queue", "put_trajectory")
         return result
 

@@ -23,6 +23,7 @@ import numpy as np
 from scalable_agent_tpu.native import load_library
 from scalable_agent_tpu.obs import (
     get_flight_recorder,
+    get_ledger,
     get_registry,
     get_tracer,
     get_watchdog,
@@ -175,6 +176,7 @@ class NativeBatcher:
                 self._batch_size_hist.observe(n)
                 self._occupancy_hist.observe(n / self._max)
                 self._batches_total.inc()
+                started_at = time.monotonic()
                 with get_tracer().span("batcher/native_run_batch",
                                        args={"n": n}):
                     batched = self._sample_layout.unpack_rows(
@@ -190,6 +192,11 @@ class NativeBatcher:
                     result_buf = bytearray(n * self._result_layout.nbytes)
                     self._result_layout.pack_rows(
                         memoryview(result_buf), result, n)
+                # Same service-stage feed as the Python batcher: the
+                # ledger's inference-service ρ covers both cores.
+                get_ledger().note_service(
+                    "inference_service", n,
+                    time.monotonic() - started_at)
                 result_c = (ctypes.c_char * len(result_buf)).from_buffer(
                     result_buf)
                 self._lib.batcher_set_results(

@@ -36,7 +36,7 @@ from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from scalable_agent_tpu.obs import get_registry, get_tracer
+from scalable_agent_tpu.obs import get_ledger, get_registry, get_tracer
 
 __all__ = [
     "FlatRowLayout",
@@ -517,16 +517,24 @@ class PackedTransport:
             # Already on device (accum paths): re-shard, don't fetch.
             return self._per_leaf.put(trajectory)
         tracer = get_tracer()
+        # Provenance stamps on the calling thread's CURRENT record
+        # (set at the pool-queue hand-off) — no-ops when no record is
+        # bound (bench/eval callers).
+        ledger = get_ledger()
         with tracer.span("transport/pack", cat="h2d"), \
                 self._h_pack.time():
             buf = self.pack(trajectory)
+        ledger.stamp_current("transport_pack")
         with tracer.span("transport/upload", cat="h2d",
                          args={"bytes": int(buf.nbytes)}), \
                 self._h_upload.time():
             device_buf = self.upload(buf)
+        ledger.stamp_current("transport_upload")
         with tracer.span("transport/unpack", cat="h2d"), \
                 self._h_unpack.time():
-            return self.unpack(device_buf)
+            result = self.unpack(device_buf)
+        ledger.stamp_current("transport_unpack")
+        return result
 
 
 def make_transport(name: str, mesh, shardings_prefix, batch_axes_prefix):
@@ -554,6 +562,13 @@ class InflightWindow:
     hard backpressure, and every retired metrics dict belongs to a known
     update (FIFO: metrics are observed in dispatch order, so per-update
     ``env_frames`` accounting stays exact).  W=1 is lock-step.
+
+    The window also owns the END of each trajectory's ledger record
+    (obs/ledger.py): ``push`` carries the trajectory's provenance id,
+    ``retire`` stamps/closes it ``retired=True``, and ``discard`` — the
+    non-finite-rollback path — closes every pending record
+    ``retired=False`` (counted into ``ledger/frames_discarded_total``)
+    instead of letting discarded frames vanish from all accounting.
     """
 
     def __init__(self, window: int, registry=None):
@@ -582,18 +597,22 @@ class InflightWindow:
     def full(self) -> bool:
         return len(self._pending) >= self.window
 
-    def push(self, metrics) -> None:
-        self._pending.append(metrics)
+    def push(self, metrics, ledger_id: Optional[int] = None) -> None:
+        self._pending.append((metrics, ledger_id))
 
     def retire(self):
         """Block until the OLDEST in-flight update's outputs exist and
         return its metrics (device arrays, ready to fetch for free)."""
         import jax
 
-        metrics = self._pending.popleft()
+        metrics, tid = self._pending.popleft()
         with get_tracer().span("learner/retire", cat="learner"), \
                 self._h_retire.time():
             jax.block_until_ready(metrics)
+        if tid is not None:
+            ledger = get_ledger()
+            ledger.stamp(tid, "retire")
+            ledger.close(tid, retired=True)
         return metrics
 
     def drain(self):
@@ -608,7 +627,13 @@ class InflightWindow:
         """Drop every in-flight metrics dict WITHOUT materializing it
         (the rollback path: pending updates belong to the abandoned
         timeline, blocking on them would only stretch the outage).
-        Returns how many were dropped."""
+        Returns how many were dropped.  Their ledger records close as
+        ``retired=False`` — the frames are DISCARDED, and the ledger's
+        ``frames_discarded_total`` counter says so."""
         dropped = len(self._pending)
+        ledger = get_ledger()
+        for _, tid in self._pending:
+            if tid is not None:
+                ledger.close(tid, retired=False, fate="discarded")
         self._pending.clear()
         return dropped

@@ -354,6 +354,66 @@ class TestResilienceRegressionGuard:
         assert any("skipped update" in w for w in diag["warnings"])
 
 
+class TestLedgerRegressionGuard:
+    """ISSUE 8 satellite: the pipeline-ledger budget guard (<2% of the
+    update stage, bench_ledger) fails on TPU, warns on the CPU
+    fallback, and protects its keys obs-guard-style against silently
+    vanishing between rounds."""
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "ledger_stamp_us": 1.5,
+                "ledger_record_lifecycle_us": 20.0,
+                "ledger_bind_lookup_us": 2.0,
+                "ledger_publish_us_per_record": 40.0}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self):
+        diag = self._diag(ledger_overhead_frac_on_update=0.05)
+        bench.ledger_regression_guard(diag)
+        assert any("LEDGER" in e for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self):
+        diag = self._diag(platform="cpu",
+                          ledger_overhead_frac_on_update=0.05)
+        bench.ledger_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("LEDGER" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self):
+        diag = self._diag(ledger_overhead_frac_on_update=0.005)
+        bench.ledger_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.ledger_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", "ledger_stamp_us": 1.5}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        diag = {"errors": [], "platform": "tpu"}
+        bench.ledger_regression_guard(diag, bench_dir=str(tmp_path))
+        assert any("LEDGER REGRESSION" in e and "ledger_stamp_us" in e
+                   for e in diag["errors"])
+
+    def test_bench_ledger_stage_emits_all_guarded_keys(self):
+        """The stage itself (hermetic, <1s) publishes every key the
+        guard protects, and the derived fraction is inside the budget
+        on this rig given a production-scale update."""
+        diag = {"errors": [], "sec_per_update": 0.005,
+                "platform": "cpu"}
+        bench.bench_ledger(diag)
+        for key in bench.LEDGER_GUARD_KEYS:
+            assert diag.get(key) is not None, key
+        assert diag["ledger_overhead_frac_on_update"] > 0.0
+
+
 class TestElasticRegressionGuard:
     """ISSUE 6 satellite: the elastic supervisor's steady-state budget
     guard (<0.5% of the update stage) fails on TPU, warns on the CPU

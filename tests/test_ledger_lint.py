@@ -1,0 +1,147 @@
+"""Static guard: every timing stage the runtime registers maps into the
+pipeline ledger's stage graph.
+
+The ledger (obs/ledger.py) exists to decompose the pipeline's time, so
+a NEW timing histogram (a registry name ending ``_s`` registered from
+``runtime/`` or ``driver.py``) that the ledger doesn't know about is a
+blind spot by construction.  This test (the ``test_collective_lint.py``
+pattern) walks the ASTs, collects every ``.histogram("..._s")``
+registration — including f-string names like
+``f"{metrics_name}/request_latency_s"``, matched by their constant
+suffix — and fails unless the name appears in
+``ledger.TIMING_STAGE_MAP`` or in the explicit ``ALLOWLIST`` of
+deliberate non-pipeline timings.  Stale allowlist entries fail too, so
+the list can only shrink.
+"""
+
+import ast
+import os
+
+import scalable_agent_tpu
+from scalable_agent_tpu.obs.ledger import (
+    SEGMENTS,
+    SERVICE_STAGES,
+    TIMING_STAGE_MAP,
+)
+
+PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
+
+# Timing histograms that deliberately do NOT map to a ledger stage,
+# with the justification.  Every entry must still match a live
+# registration site — a stale entry fails.
+ALLOWLIST = {
+    # Checkpoint cadence is run infrastructure, not a per-trajectory
+    # pipeline stage: no frame's latency passes through a save.
+    "checkpoint/save_s",
+}
+
+
+def _histogram_names(path):
+    """Every first-argument name passed to a ``.histogram(...)`` call:
+    plain strings verbatim; f-strings as ('suffix', <constant tail>)."""
+    tree = ast.parse(open(path).read(), filename=path)
+    names = []
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "histogram"
+                and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            names.append(("exact", arg.value, node.lineno))
+        elif isinstance(arg, ast.JoinedStr):
+            tail = ""
+            for part in reversed(arg.values):
+                if isinstance(part, ast.Constant) and isinstance(
+                        part.value, str):
+                    tail = part.value + tail
+                else:
+                    break
+            names.append(("suffix", tail, node.lineno))
+    return names
+
+
+def collect_timing_sites():
+    files = [os.path.join(PKG_DIR, "driver.py")]
+    runtime_dir = os.path.join(PKG_DIR, "runtime")
+    files += sorted(
+        os.path.join(runtime_dir, name)
+        for name in os.listdir(runtime_dir) if name.endswith(".py"))
+    sites = []
+    for path in files:
+        rel = os.path.relpath(path, PKG_DIR)
+        for kind, name, lineno in _histogram_names(path):
+            if name.endswith("_s"):
+                sites.append((rel, lineno, kind, name))
+    return sites
+
+
+def _matches(kind, name, candidates):
+    if kind == "exact":
+        return name in candidates
+    # f-string site: the constant suffix must match at least one known
+    # name's tail (e.g. "/request_latency_s" hits both batcher maps).
+    return any(candidate.endswith(name) for candidate in candidates)
+
+
+def test_every_timing_stage_maps_into_the_ledger():
+    known = set(TIMING_STAGE_MAP) | ALLOWLIST
+    sites = collect_timing_sites()
+    assert sites, "lint found no timing histograms — walker broken"
+    offenders = [
+        f"{rel}:{lineno} histogram {name!r} has no ledger stage "
+        f"mapping (add it to obs/ledger.py TIMING_STAGE_MAP or, with "
+        f"justification, to this test's ALLOWLIST)"
+        for rel, lineno, kind, name in sites
+        if not _matches(kind, name, known)
+    ]
+    assert not offenders, "\n".join(offenders)
+
+
+def test_allowlist_has_no_stale_entries():
+    sites = collect_timing_sites()
+
+    def live(entry):
+        return any(
+            _matches(kind, name, {entry}) or name == entry
+            for _, _, kind, name in sites)
+
+    stale = {entry for entry in ALLOWLIST if not live(entry)}
+    assert not stale, (
+        f"ALLOWLIST entries no longer match any timing histogram "
+        f"registration (delete them): {sorted(stale)}")
+
+
+def test_map_entries_match_real_sites():
+    """The inverse direction: every TIMING_STAGE_MAP key must still
+    name a real registration, so a renamed histogram can't leave a
+    stale mapping pretending the stage is covered."""
+    sites = collect_timing_sites()
+    for key in TIMING_STAGE_MAP:
+        assert any(
+            (kind == "exact" and name == key)
+            or (kind == "suffix" and key.endswith(name))
+            for _, _, kind, name in sites), (
+            f"TIMING_STAGE_MAP key {key!r} matches no histogram "
+            f"registration in runtime//driver.py")
+
+
+def test_map_targets_are_ledger_stages():
+    names = {name for name, _, _ in SEGMENTS} | set(SERVICE_STAGES)
+    for metric, segment in TIMING_STAGE_MAP.items():
+        assert segment in names, (metric, segment)
+
+
+def test_lint_actually_sees_the_known_sites():
+    """The walker must FIND today's known sites (an AST bug that finds
+    nothing would green-light everything)."""
+    sites = collect_timing_sites()
+    exact = {name for _, _, kind, name in sites if kind == "exact"}
+    suffixes = {name for _, _, kind, name in sites if kind == "suffix"}
+    assert "actor/env_step_s" in exact
+    assert "learner/put_trajectory_s" in exact
+    assert "transport/pack_s" in exact
+    assert "checkpoint/save_s" in exact
+    assert "/request_latency_s" in suffixes
