@@ -1331,6 +1331,189 @@ def bench_transport(diag, budget_s=150.0):
 TRANSPORT_GUARD_MIN_OVERLAP = 0.5
 
 
+def bench_actor_service(diag, budget_s=240.0, platform="tpu"):
+    """ISSUE 10 acceptance: the continuous-batching actor service
+    (--actor=service, runtime/service.py) vs the grouped lockstep pool
+    at EQUAL env/worker count, through the driver's own prefetch stage
+    and real subprocess env workers — e2e env_frames/s for both, plus
+    the service's batch-occupancy histogram and the request→action p99
+    (the numbers the bucketing policy and max-batch sizing tune
+    against)."""
+    import queue as queue_lib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.driver import (
+        probe_env, start_prefetch, zero_trajectory)
+    from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+    from scalable_agent_tpu.envs.spec import TensorSpec
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.obs import get_registry
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import (
+        ActorPool, Learner, LearnerHyperparams)
+    from scalable_agent_tpu.runtime.service import ActorService
+
+    repeats = 1  # identical on both sides; keeps the env step cheap
+    if platform == "cpu":  # fallback diagnosis run, keep it tiny
+        num_groups, group_size, workers = 2, 8, 2
+        unroll_len, height, width = 20, 32, 32
+        target_updates = 6
+    else:
+        num_groups = int(os.environ.get("BENCH_SERVICE_GROUPS", "4"))
+        group_size = int(
+            os.environ.get("BENCH_SERVICE_GROUP_SIZE", "64"))
+        workers = int(os.environ.get("BENCH_SERVICE_WORKERS", "8"))
+        unroll_len, height, width = 50, 72, 96
+        target_updates = 20
+    frames_per_update = group_size * unroll_len * repeats
+    diag["service_config"] = {
+        "groups": num_groups, "group_size": group_size,
+        "workers_per_group": workers, "unroll_length": unroll_len,
+    }
+
+    agent = ImpalaAgent(num_actions=9,
+                        compute_dtype=(jnp.float32 if platform == "cpu"
+                                       else jnp.bfloat16),
+                        core_impl=_core_impl())
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    learner = Learner(agent, LearnerHyperparams(), mesh,
+                      frames_per_update=frames_per_update)
+    cfg = Config(level_name="fake_benchmark", height=height, width=width,
+                 batch_size=group_size, unroll_length=unroll_len)
+    obs_spec, _, _ = probe_env(cfg)
+    state = learner.init(
+        jax.random.key(0),
+        zero_trajectory(cfg, obs_spec, agent, batch=group_size))
+    frame_spec = TensorSpec((height, width, 3), np.uint8, "frame")
+
+    def make_groups():
+        return [
+            MultiEnv(
+                [functools.partial(
+                    make_impala_stream, "fake_benchmark",
+                    seed=g * 10000 + i, num_action_repeats=repeats,
+                    height=height, width=width)
+                 for i in range(group_size)],
+                frame_spec, num_workers=workers)
+            for g in range(num_groups)
+        ]
+
+    def run_pipeline(kind, state, budget):
+        groups = make_groups()
+        # EQUAL buffering on both sides: the trajectory-queue depth
+        # bounds how much learner-cadence jitter either runtime can
+        # absorb, so an asymmetric capacity would bias the ratio the
+        # guard enforces.
+        if kind == "service":
+            pool = ActorService(agent, groups, unroll_len,
+                                level_name="fake_benchmark",
+                                queue_capacity=2)
+        else:
+            pool = ActorPool(agent, groups, unroll_len,
+                             level_name="fake_benchmark",
+                             queue_capacity=2)
+        pool.set_params(state.params)
+        pool.start()
+        staged = queue_lib.Queue(maxsize=1)
+        stop = threading.Event()
+        thread = start_prefetch(pool, learner, staged, stop)
+        try:
+            # Warm past compiles and the queue fill so the timed window
+            # starts at steady state.
+            for _ in range(num_groups + 2):
+                traj = staged.get(timeout=600)
+                if isinstance(traj, Exception):
+                    raise traj
+                state, metrics = learner.update(state, traj)
+                pool.set_params(state.params)
+            _fetch_scalar(metrics["total_loss"])
+            updates = 0
+            t0 = time.perf_counter()
+            while (updates < target_updates
+                   and time.perf_counter() - t0 < budget):
+                traj = staged.get(timeout=600)
+                if isinstance(traj, Exception):
+                    raise traj
+                state, metrics = learner.update(state, traj)
+                pool.set_params(state.params)
+                updates += 1
+            _fetch_scalar(metrics["total_loss"])
+            dt = time.perf_counter() - t0
+            return state, updates * frames_per_update / dt, updates
+        finally:
+            stop.set()
+            pool.stop()
+            thread.join(timeout=5)
+
+    state, grouped_fps, grouped_updates = run_pipeline(
+        "grouped", state, budget_s / 2)
+    state, service_fps, service_updates = run_pipeline(
+        "service", state, budget_s / 2)
+    diag["grouped_env_frames_per_sec"] = round(grouped_fps, 1)
+    diag["service_env_frames_per_sec"] = round(service_fps, 1)
+    if grouped_fps > 0:
+        diag["service_vs_grouped"] = round(service_fps / grouped_fps, 3)
+    if min(grouped_updates, service_updates) < target_updates:
+        diag.setdefault("warnings", []).append(
+            f"bench_actor_service measured only "
+            f"{grouped_updates}/{service_updates} (grouped/service) of "
+            f"{target_updates} target updates inside the budget")
+    registry = get_registry()
+    occupancy = registry.histogram("service/occupancy").quantiles()
+    diag["service_batch_occupancy_p50"] = round(occupancy[0.5], 3)
+    diag["service_batch_occupancy_p99"] = round(occupancy[0.99], 3)
+    latency = registry.histogram("service/request_latency_s").quantiles()
+    diag["service_request_to_action_p99_us"] = round(
+        latency[0.99] * 1e6, 1)
+
+
+# The service must at least MATCH the grouped pool at equal env count
+# (the ISSUE 10 target is >= 2x on the TPU rig; 1.0 is the regression
+# floor the guard enforces so a slow round still lands with its
+# numbers on record).
+SERVICE_GUARD_MIN_RATIO = 1.0
+
+SERVICE_GUARD_KEYS = (
+    "service_vs_grouped",
+    "service_env_frames_per_sec",
+    "service_request_to_action_p99_us",
+)
+
+
+def service_regression_guard(diag, bench_dir=None):
+    """ISSUE 10 satellite: --actor=service must stay at least as fast
+    as --actor=grouped at equal env count — binding on TPU, advisory on
+    the CPU fallback (host thread scheduling dominates a CPU run, so
+    the ratio measures scheduler weather); obs-guard-style, a service
+    key the previous round's artifact published but this round didn't
+    is always an error."""
+    ratio = diag.get("service_vs_grouped")
+    if ratio is not None and ratio < SERVICE_GUARD_MIN_RATIO:
+        msg = (
+            f"SERVICE: continuous-batching service e2e fps is only "
+            f"{ratio:.2f}x the grouped pool (floor "
+            f"{SERVICE_GUARD_MIN_RATIO:.1f}x; service "
+            f"{diag.get('service_env_frames_per_sec')} vs grouped "
+            f"{diag.get('grouped_env_frames_per_sec')} env_frames/s)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory")
+        else:
+            diag["errors"].append(msg)
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in SERVICE_GUARD_KEYS:
+        if prev.get(key) is not None and diag.get(key) is None:
+            diag["errors"].append(
+                f"SERVICE REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
+
+
 def bench_resilience(diag, budget_s=90.0):
     """Resilience-layer stage (ISSUE 4): the non-finite guard fused into
     the jitted update (runtime/learner.py) must cost <1% of the update
@@ -2183,6 +2366,14 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_transport failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_actor_service"
+    try:
+        bench_actor_service(
+            diag, budget_s=240.0 if diag["platform"] != "cpu" else 60.0,
+            platform=diag["platform"])
+    except Exception:
+        diag["errors"].append(
+            "bench_actor_service failed: " + traceback.format_exc(limit=3))
     diag["stage"] = "bench_resilience"
     try:
         bench_resilience(
@@ -2239,6 +2430,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "transport regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "service_regression_guard"
+    try:
+        service_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "service regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "resilience_regression_guard"
     try:

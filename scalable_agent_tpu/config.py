@@ -109,6 +109,20 @@ class Config:
     # tunnels get 2 without per-deployment tuning.  The pool clamps
     # explicit values to the group count.
     accum_fused_shards: int = 0
+    # Host actor runtime: "grouped" (the ActorPool — one thread per env
+    # group, lockstep step_send/step_recv, the slowest env gates its
+    # group) or "service" (runtime/service.py — continuous-batching:
+    # env workers stream observations out individually, one inference
+    # thread batches whatever arrived against a device-resident LSTM
+    # state slab, per-env trajectory packing; no per-step group
+    # barrier).  docs/performance.md, "Continuous-batching actor
+    # service".
+    actor: str = "grouped"
+    # service only: the largest device batch the inference thread forms
+    # (rows = envs).  Formed batches pad up a power-of-two bucket
+    # ladder so XLA sees ~log2(max) shapes.  0 = auto (all of this
+    # process's envs — one full sweep fits one batch).
+    service_max_batch: int = 0
     # Training backend: "host" (actor pool + prefetch + learner — the
     # reference's architecture, experiment.py:479-672) or "ingraph"
     # (rollout + update fused into ONE jitted device program for

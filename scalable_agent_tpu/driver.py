@@ -10,6 +10,21 @@ Run:
         --level_name=fake_benchmark --total_environment_frames=100000
     python -m scalable_agent_tpu.driver --mode=test --logdir=...
 
+Actor runtime flags (docs/performance.md, "Continuous-batching actor
+service"):
+    --actor=grouped|service
+        ``grouped`` (default) is the lockstep ActorPool: one thread per
+        env group, the slowest env worker gates its whole group each
+        step.  ``service`` is the continuous-batching actor service
+        (runtime/service.py): env workers stream observations out the
+        moment they finish, ONE inference thread batches whatever
+        arrived (bucketed shapes, device-resident LSTM state slab), and
+        per-env trajectory packers feed the same queue/transport — no
+        per-step group barrier.
+    --service_max_batch=N
+        Largest service device batch (envs per inference call); 0 =
+        auto (all of this process's envs).
+
 Transport flags (docs/performance.md, "The trajectory transport"):
     --transport=packed|per_leaf
         How host trajectory batches reach the mesh.  ``packed`` (the
@@ -795,12 +810,31 @@ def train(config: Config) -> Dict[str, float]:
         env_groups = make_env_groups(config, observation_spec.frame,
                                      num_agents=num_agents,
                                      level_names=level_names)
-        pool = ActorPool(agent, env_groups, config.unroll_length,
-                         level_name=config.level_name, seed=config.seed,
-                         inference_mode=config.inference_mode,
-                         observation_spec=observation_spec,
-                         fused_shards=config.accum_fused_shards,
-                         max_restarts=config.actor_max_restarts)
+        if config.actor == "service":
+            # Continuous-batching actor service (runtime/service.py):
+            # same queue/get_trajectory surface as the pool, so the
+            # prefetch stage and everything downstream are unchanged.
+            from scalable_agent_tpu.runtime.service import ActorService
+
+            if config.inference_mode != "structural":
+                raise ValueError(
+                    f"--actor=service owns its inference (one "
+                    f"continuous-batching thread); inference_mode="
+                    f"{config.inference_mode!r} applies to "
+                    f"--actor=grouped only")
+            pool = ActorService(
+                agent, env_groups, config.unroll_length,
+                level_name=config.level_name, seed=config.seed,
+                max_batch=config.service_max_batch,
+                max_restarts=config.actor_max_restarts)
+        else:
+            pool = ActorPool(
+                agent, env_groups, config.unroll_length,
+                level_name=config.level_name, seed=config.seed,
+                inference_mode=config.inference_mode,
+                observation_spec=observation_spec,
+                fused_shards=config.accum_fused_shards,
+                max_restarts=config.actor_max_restarts)
         pool.set_params(state.params)
         pool.start()
 
@@ -1192,6 +1226,9 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     if config.transport not in ("packed", "per_leaf"):
         raise ValueError(
             f"unknown transport {config.transport!r} (packed | per_leaf)")
+    if config.actor not in ("grouped", "service"):
+        raise ValueError(
+            f"unknown actor {config.actor!r} (grouped | service)")
     transport = config.transport
     if (transport == "packed" and jax.process_count() > 1
             and jax.devices()[0].platform == "cpu"):
@@ -1263,6 +1300,10 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         raise ValueError(
             "train_backend=ingraph is single-process (the host backend "
             "covers multi-host training)")
+    if config.actor == "service":
+        raise ValueError(
+            "train_backend=ingraph has no host actor pipeline; "
+            "--actor=service applies to the host backend")
     config = apply_env_overrides(config)
     config.save()
     configure_faults(config.chaos_spec)  # disarmed again in the finally

@@ -18,6 +18,7 @@ algorithms/utils/multi_env.py:42-225) re-shaped for feeding a TPU:
 
 import multiprocessing as mp
 import pickle
+import threading
 from collections import deque
 from multiprocessing import shared_memory
 from typing import Callable, List, Optional, Sequence
@@ -261,6 +262,7 @@ class MultiEnv:
         self._respawn_times = []
         self._procs = []
         self._conns = []
+        self._send_locks = []
         start = 0
         for w, size in enumerate(sizes):
             sl = slice(start, start + size)
@@ -271,6 +273,12 @@ class MultiEnv:
             self._respawn_times.append(deque())
             self._procs.append(None)
             self._conns.append(None)
+            # Per-worker send lock (RLock so a caller can wrap its own
+            # check-then-send critical section around worker_send): the
+            # per-worker async API lets one thread dispatch steps while
+            # another drains replies, and a respawn's send+recv
+            # handshake must never interleave with a concurrent send.
+            self._send_locks.append(threading.RLock())
             self._spawn_worker(w)
             start += size
         failures = []
@@ -366,6 +374,42 @@ class MultiEnv:
 
     # -- protocol ----------------------------------------------------------
 
+    def _recv_payload(self, w: int):
+        """One worker's reply with the shared fault handling: a worker
+        dead mid-step is respawned and its slice's fresh initial
+        outputs substituted (done=True marks the episode boundary; the
+        aborted episode records no stats — episode_step stays 0).
+        Returns ``(payload, None)`` or ``(None, remote_error)``."""
+        conn = self._conns[w]
+        try:
+            ok, payload = conn.recv()
+        except (EOFError, OSError):
+            with self._send_locks[w]:
+                if self._conns[w] is conn:
+                    self._respawn_worker(w)
+                    self._conns[w].send((_INITIAL,))
+                # else: a concurrent sender already noticed the death,
+                # respawned, and primed _INITIAL under this lock — a
+                # second respawn would kill the healthy replacement
+                # and double-charge the budget for one death.  Either
+                # way the primed initial reply is pending below.
+            ok, payload = self._conns[w].recv()
+        if not ok:
+            return None, pickle.loads(payload)
+        return payload, None
+
+    def _record_done_stats(self, offset: int, dones, steps, returns):
+        """Completed-episode accounting for a slice whose global env
+        indices start at ``offset`` (skips initial() pseudo-dones)."""
+        for i in np.nonzero(dones)[0]:
+            if steps[i] > 0:
+                self.episode_stats.append(
+                    (float(returns[i]), int(steps[i])))
+                if self.env_labels is not None:
+                    self.level_episode_stats.append(
+                        (self.env_labels[offset + i], float(returns[i]),
+                         int(steps[i])))
+
     def _gather(self) -> StepOutput:
         rewards = np.zeros((self.num_envs,), np.float32)
         dones = np.zeros((self.num_envs,), bool)
@@ -375,18 +419,11 @@ class MultiEnv:
         measurements = None
         errors = []
         for w, sl in enumerate(self._slices):
-            try:
-                ok, payload = self._conns[w].recv()
-            except (EOFError, OSError):
-                # Worker died mid-step: respawn and substitute its
-                # slice's fresh initial outputs (done=True marks the
-                # episode boundary; the aborted episode records no
-                # stats — episode_step stays 0).
-                self._respawn_worker(w)
-                self._conns[w].send((_INITIAL,))
-                ok, payload = self._conns[w].recv()
-            if not ok:
-                errors.append(pickle.loads(payload))
+            payload, error = self._recv_payload(w)
+            if error is not None:
+                # Keep draining the remaining workers so the pipes stay
+                # aligned; the first error surfaces after the sweep.
+                errors.append(error)
                 continue
             r, d, ret, st, instr, meas = payload
             rewards[sl], dones[sl], returns[sl], steps[sl] = r, d, ret, st
@@ -402,14 +439,7 @@ class MultiEnv:
                 measurements[sl] = meas
         if errors:
             raise errors[0]
-        for i in np.nonzero(dones)[0]:
-            if steps[i] > 0:  # skip initial() pseudo-done
-                self.episode_stats.append(
-                    (float(returns[i]), int(steps[i])))
-                if self.env_labels is not None:
-                    self.level_episode_stats.append(
-                        (self.env_labels[i], float(returns[i]),
-                         int(steps[i])))
+        self._record_done_stats(0, dones, steps, returns)
         return StepOutput(
             reward=rewards,
             info=StepOutputInfo(episode_return=returns, episode_step=steps),
@@ -421,11 +451,12 @@ class MultiEnv:
 
     def initial(self) -> StepOutput:
         for w in range(len(self._conns)):
-            try:
-                self._conns[w].send((_INITIAL,))
-            except (BrokenPipeError, OSError):
-                self._respawn_worker(w)
-                self._conns[w].send((_INITIAL,))
+            with self._send_locks[w]:
+                try:
+                    self._conns[w].send((_INITIAL,))
+                except (BrokenPipeError, OSError):
+                    self._respawn_worker(w)
+                    self._conns[w].send((_INITIAL,))
         return self._gather()
 
     def step_send(self, actions) -> None:
@@ -434,13 +465,7 @@ class MultiEnv:
             raise ValueError(
                 f"got {actions.shape[0]} actions for {self.num_envs} envs")
         for w, sl in enumerate(self._slices):
-            try:
-                self._conns[w].send((_STEP, actions[sl]))
-            except (BrokenPipeError, OSError):
-                # Dead worker: respawn and request its initial outputs
-                # instead of the lost step (same payload layout).
-                self._respawn_worker(w)
-                self._conns[w].send((_INITIAL,))
+            self.worker_send(w, actions[sl])
         self._pending = True
 
     def step_recv(self) -> StepOutput:
@@ -448,6 +473,96 @@ class MultiEnv:
             raise RuntimeError("step_recv without step_send")
         self._pending = False
         return self._gather()
+
+    # -- per-worker async protocol -----------------------------------------
+    # The continuous-batching actor service (runtime/service.py) steps
+    # each worker's env slice independently: a finished worker's
+    # observations flow out the moment its reply lands, without waiting
+    # for siblings — the per-step group barrier the grouped path pays
+    # in ``step_recv`` does not exist here.  Thread model: one thread
+    # may send (worker_send) while another drains replies (worker_recv)
+    # — opposite directions of the duplex pipe, serialized per worker
+    # by the send lock only where a respawn handshake needs it.
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._slices)
+
+    def worker_slices(self) -> List[slice]:
+        """Per-worker env index ranges, in batch order."""
+        return list(self._slices)
+
+    def worker_connection(self, w: int):
+        """The worker's parent-side pipe end, for
+        ``multiprocessing.connection.wait`` readiness polling."""
+        return self._conns[w]
+
+    def worker_lock(self, w: int):
+        """The worker's send RLock — callers wrap check-then-send
+        critical sections (e.g. the service's stale-generation gate)
+        around ``worker_send``."""
+        return self._send_locks[w]
+
+    def worker_generation(self, w: int) -> int:
+        """The worker's respawn generation (bumped by every
+        ``_respawn_worker``, always under the send lock on concurrent
+        paths).  The actor service stamps requests with it so a step
+        computed for a PRE-respawn worker is discarded instead of
+        dispatched — a respawn's _INITIAL prime already has a reply in
+        flight, and dispatching on top of it would double-book the
+        strict request/reply protocol."""
+        return self._generations[w]
+
+    def _slice_output(self, w: int, payload) -> StepOutput:
+        sl = self._slices[w]
+        rewards, dones, returns, steps, instructions, measurements = payload
+        self._record_done_stats(sl.start, dones, steps, returns)
+        return StepOutput(
+            reward=rewards,
+            info=StepOutputInfo(episode_return=returns,
+                                episode_step=steps),
+            done=dones,
+            observation=Observation(
+                frame=self._slab[sl].copy(), instruction=instructions,
+                measurements=measurements),
+        )
+
+    def worker_send(self, w: int, actions) -> None:
+        """Dispatch one step to worker ``w``'s env slice ([k] actions).
+        A dead worker is respawned and primed with its initial outputs
+        instead of the lost step (same payload layout)."""
+        actions = np.asarray(actions)
+        sl = self._slices[w]
+        if actions.shape[0] != sl.stop - sl.start:
+            raise ValueError(
+                f"got {actions.shape[0]} actions for worker {w}'s "
+                f"{sl.stop - sl.start} envs")
+        with self._send_locks[w]:
+            try:
+                self._conns[w].send((_STEP, actions))
+            except (BrokenPipeError, OSError):
+                self._respawn_worker(w)
+                self._conns[w].send((_INITIAL,))
+
+    def worker_recv(self, w: int) -> StepOutput:
+        """Collect worker ``w``'s outstanding reply as a slice-shaped
+        [k, ...] StepOutput (frames copied from the slab slice;
+        episode stats recorded with global env indices)."""
+        payload, error = self._recv_payload(w)
+        if error is not None:
+            raise error
+        return self._slice_output(w, payload)
+
+    def worker_initial(self, w: int) -> StepOutput:
+        """(Re)start worker ``w``'s episodes and return its slice's
+        initial outputs."""
+        with self._send_locks[w]:
+            try:
+                self._conns[w].send((_INITIAL,))
+            except (BrokenPipeError, OSError):
+                self._respawn_worker(w)
+                self._conns[w].send((_INITIAL,))
+        return self.worker_recv(w)
 
     def resync(self) -> None:
         """Best-effort pipe re-alignment after an exception of unknown

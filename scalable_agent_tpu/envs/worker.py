@@ -211,6 +211,17 @@ class EnvProcess:
         self._pending = True
         self._conn.send((_STEP, action))
 
+    def step_ready(self, timeout: float = 0.0) -> bool:
+        """Async completion probe: True when a dispatched step's reply
+        is readable (``step_recv`` will not block); False with no step
+        outstanding.  The single-env analogue of the per-worker
+        readiness polling MultiEnv exposes through
+        ``worker_connection`` (which the actor service drives with
+        ``multiprocessing.connection.wait``)."""
+        if not self._pending:
+            return False
+        return self._conn.poll(timeout)
+
     def step_recv(self):
         """Async half: collect a previously dispatched step."""
         if not self._pending:

@@ -75,6 +75,7 @@ __all__ = [
     "SEGMENT_LABELS",
     "SEGMENTS",
     "SERVICE_STAGES",
+    "SERVICE_UTILIZATION_STAGES",
     "STAGES",
     "TIMING_STAGE_MAP",
     "PipelineLedger",
@@ -120,8 +121,20 @@ SEGMENTS = (
 # Service stages fed by note_service (arrival count + busy seconds per
 # executed batch) rather than by per-record stamps: the dynamic-batching
 # inference service runs *beside* the trajectory path, and its ρ answers
-# "is actor inference dispatch the constraint".
-SERVICE_STAGES = ("inference_service",)
+# "is actor inference dispatch the constraint".  The continuous-batching
+# actor service (runtime/service.py) splits its side into the two
+# halves a queueing model needs: ``service_wait`` (request submission →
+# batch formation; busy seconds are summed request waits, so ρ is
+# Little's-law L — how many requests sit parked) and ``service_batch``
+# (the one inference thread's batched execution; ρ is its true
+# utilization).
+SERVICE_STAGES = ("inference_service", "service_wait", "service_batch")
+
+# The subset of SERVICE_STAGES whose ρ is a genuine utilization in
+# [0, 1] (one server's busy seconds per wall second) — the stages
+# ``service_pressure()`` and the report's service-dominated verdict
+# judge saturation against.  Wait stages (ρ = L, unbounded) stay out.
+SERVICE_UTILIZATION_STAGES = ("inference_service", "service_batch")
 
 # Human labels for verdict lines and the report's stage table.
 SEGMENT_LABELS = {
@@ -132,6 +145,8 @@ SEGMENT_LABELS = {
     "staged_wait": "staging wait (learner busy)",
     "device": "device execution (in-flight window)",
     "inference_service": "dynamic-batching inference service",
+    "service_wait": "actor-service request wait (batch formation)",
+    "service_batch": "actor-service batched inference execution",
 }
 
 # Every *timing* histogram the runtime registers (names ending `_s`,
@@ -150,6 +165,11 @@ TIMING_STAGE_MAP = {
     "transport/upload_s": "transport",
     "transport/unpack_s": "transport",
     "learner/retire_s": "device",
+    "service/wait_s": "service_wait",
+    "service/batch_s": "service_batch",
+    # enqueue → action spans wait + execution; under load the wait half
+    # dominates, so the latency histogram reads with the wait stage.
+    "service/request_latency_s": "service_wait",
 }
 
 # Peak bf16 matmul FLOP/s per chip by jax device_kind prefix — the ONE
@@ -246,6 +266,10 @@ class PipelineLedger:
         self._last_publish_us = now_us()
         self._last_stats: Dict[str, object] = {}
         self._last_shares: Dict[str, float] = {}
+        # Last interval's per-service-stage ρ (persists across empty
+        # intervals, like the shares): feeds service_pressure() and the
+        # stall verdict's service attribution.
+        self._last_service_rho: Dict[str, float] = {}
 
         reg = self._registry
         self._c_opened = reg.counter(
@@ -530,6 +554,7 @@ class PipelineLedger:
                 rate_gauge.set(n / interval_s)
             if rho_gauge is not None:
                 rho_gauge.set(busy_s / interval_s)
+            self._last_service_rho[name] = busy_s / interval_s
             stats["segments"][name] = {
                 "rate_per_s": n / interval_s,
                 "rho": busy_s / interval_s}
@@ -559,6 +584,22 @@ class PipelineLedger:
             return None
         name = max(shares, key=shares.get)
         return name, shares[name]
+
+    def service_pressure(self, threshold: float = 0.5
+                         ) -> Optional[Tuple[str, float]]:
+        """The busiest *utilization-type* service stage's ``(name, ρ)``
+        when it crossed ``threshold`` in the last interval that fed it
+        — the signal that an unroll-dominated verdict is really
+        inference-service-dominated (the service runs INSIDE the unroll
+        segment, so latency shares alone can't name it)."""
+        candidates = {name: rho
+                      for name, rho in self._last_service_rho.items()
+                      if name in SERVICE_UTILIZATION_STAGES}
+        if not candidates:
+            return None
+        name = max(candidates, key=candidates.get)
+        rho = candidates[name]
+        return (name, rho) if rho >= threshold else None
 
     # -- shutdown ----------------------------------------------------------
 

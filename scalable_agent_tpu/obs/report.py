@@ -41,6 +41,7 @@ from scalable_agent_tpu.obs.ledger import (
     SEGMENT_LABELS,
     SEGMENTS,
     SERVICE_STAGES,
+    SERVICE_UTILIZATION_STAGES,
 )
 
 __all__ = ["main", "render_report"]
@@ -79,6 +80,19 @@ RECOMMENDATIONS = {
     "inference_service": (
         "the dynamic-batching inference service saturates: more "
         "consumers, larger max batch, or accum-mode actors"),
+    "service_wait": (
+        "requests park waiting for the actor service's inference "
+        "thread (rho here is Little's-law L, the parked count): raise "
+        "--service_max_batch so one device call drains more of the "
+        "ring, check service/batch_s for recompile spikes (the bucket "
+        "ladder should bound shapes), or split env groups across "
+        "processes"),
+    "service_batch": (
+        "the actor service's single inference thread runs near 100% "
+        "busy: raise --service_max_batch (bigger batches amortize "
+        "dispatch), shrink the observation (height/width), or move "
+        "inference off-host entirely (ROADMAP item 1a device-resident "
+        "rollouts / item 4 serving engine)"),
 }
 
 
@@ -231,6 +245,23 @@ def render_report(logdir: str) -> str:
         lines.append(
             "top recommendation: "
             + RECOMMENDATIONS.get(dominant, "inspect the stage table"))
+        # The inference service runs INSIDE the unroll segment, so a
+        # saturated service reads as "unroll" in the latency shares —
+        # its ρ names the real constraint (runtime/service.py).
+        if dominant == "unroll":
+            util = {
+                name: _value(families, f"ledger/rho/{name}")
+                for name in SERVICE_UTILIZATION_STAGES
+            }
+            util = {k: v for k, v in util.items() if v is not None}
+            if util:
+                busiest = max(util, key=util.get)
+                if util[busiest] >= 0.5:
+                    lines.append(
+                        f"service-dominated: {busiest} rho "
+                        f"{util[busiest]:.2f} — "
+                        + RECOMMENDATIONS.get(
+                            busiest, "inspect the service rows"))
     else:
         lines.append(
             "dominant stage: n/a (no closed ledger records published — "

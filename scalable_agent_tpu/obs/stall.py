@@ -160,6 +160,13 @@ class StallAttributor:
             if dominant is not None:
                 evidence["ledger_dominant"] = dominant[0]
                 evidence["ledger_dominant_share"] = dominant[1]
+            # The inference service runs INSIDE the unroll segment, so
+            # a saturated service reads as "unroll" in the shares; its
+            # ρ names the real constraint (runtime/service.py).
+            pressure = ledger.service_pressure()
+            if pressure is not None:
+                evidence["ledger_service"] = pressure[0]
+                evidence["ledger_service_rho"] = pressure[1]
         return category, evidence
 
     def report_stalled(self, stalled: Dict[str, float],
@@ -198,6 +205,10 @@ class StallAttributor:
             ledger_part = (
                 f"; {share:.0%} of frame latency in "
                 f"{SEGMENT_LABELS.get(dominant, dominant)}")
+        service = fractions.get("ledger_service")
+        if service:
+            rho = fractions.get("ledger_service_rho", 0.0)
+            ledger_part += f"; service {service} rho {rho:.2f}"
         return (f"pipeline {category} "
                 f"(wait_batch {fractions['wait_frac']:.0%} of learner "
                 f"interval; actor env share "

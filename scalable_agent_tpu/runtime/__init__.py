@@ -7,6 +7,12 @@ from scalable_agent_tpu.runtime.ingraph import InGraphTrainer
 from scalable_agent_tpu.runtime.batcher import (
     BatcherClosedError,
     DynamicBatcher,
+    bucket_ladder,
+    pad_to_bucket,
+)
+from scalable_agent_tpu.runtime.service import (
+    ActorService,
+    TrajectoryPacker,
 )
 from scalable_agent_tpu.runtime.faults import (
     FaultInjector,

@@ -79,6 +79,212 @@ def _stack_time(entries):
         lambda *xs: None if xs[0] is None else np.stack(xs), *entries)
 
 
+def snapshot_params_for_inference(params, device):
+    """Re-place learner params as a private single-device snapshot.
+
+    Shared by ActorPool.set_params and ActorService.set_params: the
+    snapshot must be a real COPY — ``device_put`` aliases any existing
+    copy the target device already holds (single-device meshes
+    trivially; multi-device replicated params via their local shard),
+    and the learner's donated update would free the aliased buffer out
+    from under the actors ("Array has been deleted").  Params are
+    small; the on-device copy is cheap."""
+
+    def local_view(leaf):
+        # Multi-host: a global array isn't fully addressable here.
+        # Replicated leaves carry the full value in every local shard —
+        # take this process's copy.  (Cross-host tensor-sharded params
+        # would need a DCN gather; actors don't support that layout.)
+        if (hasattr(leaf, "is_fully_addressable")
+                and not leaf.is_fully_addressable):
+            shard = leaf.addressable_shards[0].data
+            if shard.shape != leaf.shape:
+                raise NotImplementedError(
+                    "actor inference needs replicated (or host-local) "
+                    "params; got a cross-host-sharded leaf of shape "
+                    f"{leaf.shape} with local shard {shard.shape}")
+            return shard
+        return leaf
+
+    params = jax.tree_util.tree_map(local_view, params)
+    params = jax.device_put(params, device)
+    return jax.tree_util.tree_map(jnp.copy, params)
+
+
+def publish_trajectory(queue, trajectory, stop, *, actor_name: str,
+                       level_name: str, birth_us=None, frames: float = 0.0,
+                       frames_counter=None, trajectories_counter=None
+                       ) -> bool:
+    """Hand one trajectory to the learner queue with full provenance.
+
+    Opens the ledger record at the unroll's birth, binds it to the
+    trajectory OBJECT (so the consumer recovers the id regardless of
+    producer interleaving), blocks on the bounded queue re-touching the
+    watchdog (a full queue is backpressure, not a wedge), and — when
+    shutdown catches the hand-off — closes the record as ``abandoned``
+    instead of leaking it open.  Returns True when delivered.  Shared
+    by ActorPool's unroll loop and the ActorService trajectory packer
+    (runtime/service.py)."""
+    ledger = get_ledger()
+    watchdog = get_watchdog()
+    tid = ledger.open(actor_name, level_name or "actor",
+                      birth_us=birth_us)
+    ledger.stamp(tid, "unroll_done")
+    ledger.bind(id(trajectory), tid)
+    delivered = False
+    with get_tracer().span("batcher/queue_put", cat="queue"):
+        while not stop.is_set():
+            watchdog.touch()
+            try:
+                queue.put(trajectory, timeout=0.1)
+                delivered = True
+                break
+            except queue_lib.Full:
+                continue
+    if delivered:
+        ledger.stamp(tid, "queue_put")
+        get_flight_recorder().record("queue", "put")
+        if trajectories_counter is not None:
+            trajectories_counter.inc()
+        if frames_counter is not None and frames:
+            frames_counter.inc(frames)
+    else:
+        # Shutdown caught the hand-off: the record must not leak open
+        # (and its binding must not alias a later object at the same
+        # address).
+        ledger.unbind(id(trajectory))
+        ledger.close(tid, retired=False, fate="abandoned")
+    return delivered
+
+
+def consume_trajectory(queue, timeout: Optional[float] = None):
+    """The learner-side half of the queue hand-off (ActorPool and
+    ActorService ``get_trajectory``): pop one item, re-raise marshalled
+    producer exceptions, recover the provenance record bound to the
+    object and make it the consuming thread's CURRENT record so the
+    transport/learner layers downstream stamp the right one."""
+    with get_tracer().span("batcher/queue_get", cat="queue"):
+        item = queue.get(timeout=timeout)
+    get_flight_recorder().record("queue", "get")
+    if isinstance(item, Exception):
+        raise item
+    ledger = get_ledger()
+    tid = ledger.lookup(id(item))
+    if tid is not None:
+        ledger.stamp(tid, "queue_get")
+    ledger.set_current(tid)
+    return item
+
+
+def merged_episode_stats(envs_iter):
+    """Merged completed-episode (return, length) ring buffers across a
+    fleet of MultiEnvs (ActorPool and ActorService share this)."""
+    stats = []
+    for envs in envs_iter:
+        stats.extend(envs.episode_stats)
+    return stats
+
+
+def drain_level_stats(envs_iter):
+    """Pop all level-attributed episodes completed since the last
+    drain: {level_name: [(episode_return, episode_length), ...]}.
+
+    Feeds multi-task per-level metrics and the DMLab-30 training suite
+    score (reference: experiment.py:634-667, which clears the per-level
+    lists after each score — draining gives the same
+    each-episode-counted-once semantics).  popleft is atomic, so env
+    threads can keep appending during the drain.  Shared by ActorPool
+    and ActorService."""
+    by_level = {}
+    for envs in envs_iter:
+        queue = getattr(envs, "level_episode_stats", None)
+        if not queue:
+            continue
+        while True:
+            try:
+                level, ret, length = queue.popleft()
+            except IndexError:
+                break
+            by_level.setdefault(level, []).append((ret, length))
+    return by_level
+
+
+def run_with_retry(loop_fn, *, stop: threading.Event, deliver,
+                   reset=None, max_restarts: int = 3,
+                   backoff_s: float = 0.5, backoff_cap_s: float = 30.0,
+                   window_s: float = 600.0, restarts_counter=None):
+    """Bounded-respawn shell around a producer thread's steady-state
+    loop: a transient simulator/link fault must not end a multi-day run
+    (docs/robustness.md).
+
+    ``loop_fn`` runs until clean stop or an exception; a failure gets
+    ``max_restarts`` respawns within a sliding ``window_s`` (crash-loop
+    detection — isolated faults days apart age out) with capped
+    exponential backoff, ``reset()`` called before each retry; the
+    terminal exception goes to ``deliver(exc)`` (the queue hand-off
+    that marshals it to the driver).  Shared by ActorPool's actor
+    threads and the ActorService env-group threads."""
+    from collections import deque as _deque
+
+    from scalable_agent_tpu.utils import log
+
+    recorder = get_flight_recorder()
+    thread_name = threading.current_thread().name
+    restart_times = _deque()
+    try:
+        while not stop.is_set():
+            try:
+                loop_fn()
+                return  # clean stop
+            except Exception as exc:
+                if stop.is_set():
+                    return  # shutdown cascade (e.g. batcher closed)
+                recorder.record("exception", type(exc).__name__,
+                                {"where": thread_name})
+                now = time.monotonic()
+                while (restart_times
+                       and now - restart_times[0] > window_s):
+                    restart_times.popleft()
+                if len(restart_times) >= max_restarts:
+                    # Budget spent: surface the terminal failure.  The
+                    # deliver hand-off carries the exception to the
+                    # driver; the flight-recorder dump preserves THIS
+                    # thread's last moments (ring tail + every thread's
+                    # stack) even if the driver never drains it.
+                    recorder.dump_all(
+                        f"exception:{type(exc).__name__}:{thread_name}")
+                    deliver(exc)
+                    return
+                restart_times.append(now)
+                in_window = len(restart_times)
+                backoff = min(backoff_cap_s,
+                              backoff_s * 2 ** (in_window - 1))
+                if restarts_counter is not None:
+                    restarts_counter.inc()
+                recorder.record(
+                    "actor_restart", thread_name,
+                    {"restart": in_window, "max": max_restarts,
+                     "backoff_s": round(backoff, 3),
+                     "error": type(exc).__name__})
+                log.error(
+                    "actor %s failed (%s: %s) — restart %d/%d in the "
+                    "%.0fs window, retrying in %.2fs",
+                    thread_name, type(exc).__name__, exc, in_window,
+                    max_restarts, window_s, backoff)
+                # Idle backoff is not a wedge; the next loop's touch
+                # re-arms the heartbeat.
+                get_watchdog().suspend()
+                if reset is not None:
+                    try:
+                        reset()
+                    except Exception:
+                        log.exception("actor %s reset failed before "
+                                      "retry", thread_name)
+                stop.wait(backoff)
+    finally:
+        get_watchdog().suspend()
+
+
 def _service_step(agent, params, key_data, actions, env_outputs, states):
     """k co-batched group requests ([k, B, ...]) -> [k, B, ...] outputs.
 
@@ -444,6 +650,8 @@ class ActorPool:
     def _ensure_batcher(self, example_sample):
         with self._batcher_lock:
             if self._batcher is None:
+                from scalable_agent_tpu.runtime.batcher import (
+                    bucket_ladder)
                 from scalable_agent_tpu.runtime.native_batcher import (
                     NativeBatcher)
 
@@ -453,9 +661,7 @@ class ActorPool:
                         example_sample), 1)
                 example_result = map_structure(
                     lambda x: None if x is None else x[0], example_result)
-                pad = [1]
-                while pad[-1] < self._service_max:
-                    pad.append(min(pad[-1] * 2, self._service_max))
+                pad = bucket_ladder(self._service_max)
                 self._batcher = NativeBatcher(
                     self._service_compute,
                     example_sample=example_sample,
@@ -487,37 +693,11 @@ class ActorPool:
         live solely on the inference device (a 1-device mesh): there
         ``device_put`` aliases the learner's buffers, and the learner's
         donated update (donate_argnums) would invalidate the actors'
-        snapshot on the very next step ("Array has been deleted").  On a
-        multi-device mesh the resharding device_put materializes fresh
-        buffers by itself, so the extra copy is skipped.
+        snapshot on the very next step ("Array has been deleted").
+        ``snapshot_params_for_inference`` owns that re-placement.
         """
-        def local_view(leaf):
-            # Multi-host: a global array isn't fully addressable here.
-            # Replicated leaves carry the full value in every local
-            # shard — take this process's copy.  (Cross-host
-            # tensor-sharded params would need a DCN gather; actors
-            # don't support that layout.)
-            if (hasattr(leaf, "is_fully_addressable")
-                    and not leaf.is_fully_addressable):
-                shard = leaf.addressable_shards[0].data
-                if shard.shape != leaf.shape:
-                    raise NotImplementedError(
-                        "actor inference needs replicated (or host-"
-                        "local) params; got a cross-host-sharded leaf "
-                        f"of shape {leaf.shape} with local shard "
-                        f"{shard.shape}")
-                return shard
-            return leaf
-
-        params = jax.tree_util.tree_map(local_view, params)
-        params = jax.device_put(params, self._inference_device)
-        # ALWAYS materialize a private copy: device_put aliases any
-        # existing copy the target device already holds (single-device
-        # meshes trivially; multi-device replicated params via their
-        # local shard), and the learner's donated update would free the
-        # aliased buffer out from under the actors ("Array has been
-        # deleted").  Params are small; the on-device copy is cheap.
-        params = jax.tree_util.tree_map(jnp.copy, params)
+        params = snapshot_params_for_inference(params,
+                                               self._inference_device)
         with self._params_lock:
             self._params = params
             self._params_version = (
@@ -571,118 +751,43 @@ class ActorPool:
             items = result if isinstance(result, list) else [result]
             recorder.record("unroll", actor.level_name or "actor",
                             {"trajectories": len(items)})
-            ledger = get_ledger()
             thread_name = threading.current_thread().name
             birth_us = getattr(actor, "unroll_birth_us", None)
             for trajectory in items:
-                # Provenance record: born at the unroll's first env
-                # step, bound to the trajectory OBJECT so the consumer
-                # recovers the id regardless of producer interleaving.
-                tid = ledger.open(thread_name,
-                                  actor.level_name or "actor",
-                                  birth_us=birth_us)
-                ledger.stamp(tid, "unroll_done")
-                ledger.bind(id(trajectory), tid)
-                delivered = False
-                with tracer.span("batcher/queue_put", cat="queue"):
-                    while not self._stop.is_set():
-                        watchdog.touch()  # a full queue is not a wedge
-                        try:
-                            self.queue.put(trajectory, timeout=0.1)
-                            delivered = True
-                            break
-                        except queue_lib.Full:
-                            continue
-                if delivered:  # shutdown can abandon the put
-                    ledger.stamp(tid, "queue_put")
-                    recorder.record("queue", "put")
-                    self._trajectories_counter.inc()
-                    self._frames_counter.inc(
-                        self._frames_per_trajectory)
-                else:
-                    # Shutdown caught the hand-off: the record must not
-                    # leak open (and its binding must not alias a later
-                    # object at the same address).
-                    ledger.unbind(id(trajectory))
-                    ledger.close(tid, retired=False, fate="abandoned")
+                # Provenance record born at the unroll's first env step,
+                # bound to the trajectory object; shutdown can abandon
+                # the put (publish_trajectory closes the record then).
+                publish_trajectory(
+                    self.queue, trajectory, self._stop,
+                    actor_name=thread_name,
+                    level_name=actor.level_name,
+                    birth_us=birth_us,
+                    frames=self._frames_per_trajectory,
+                    frames_counter=self._frames_counter,
+                    trajectories_counter=self._trajectories_counter)
 
     def _actor_loop(self, actor: VectorActor):
-        """Retry shell around ``_unroll_loop``: a failing actor thread
-        gets ``max_restarts`` respawns within a sliding
-        ``restart_window_s`` (crash-loop detection — isolated faults
-        days apart age out) with capped exponential backoff before its
-        terminal exception is marshalled to the driver — a transient
-        simulator/link fault must not end a multi-day run
+        """Retry shell around ``_unroll_loop``: the shared
+        ``run_with_retry`` gives a failing actor thread
+        ``max_restarts`` respawns within a sliding ``restart_window_s``
+        (crash-loop detection — isolated faults days apart age out)
+        with capped exponential backoff before its terminal exception
+        is marshalled to the driver through the queue
         (docs/robustness.md)."""
-        from scalable_agent_tpu.utils import log
 
-        from collections import deque
+        def deliver(exc):
+            self._errors.append(exc)
+            self.queue.put(exc)
 
-        recorder = get_flight_recorder()
-        thread_name = threading.current_thread().name
-        # Restart timestamps within the sliding window (the budget
-        # detects crash LOOPS; a fault that struck hours ago has aged
-        # out — same semantics as MultiEnv._respawn_worker).
-        restart_times = deque()
-        try:
-            while not self._stop.is_set():
-                try:
-                    self._unroll_loop(actor)
-                    return  # clean stop
-                except Exception as exc:
-                    if self._stop.is_set():
-                        return  # shutdown cascade (e.g. batcher closed)
-                    recorder.record("exception", type(exc).__name__,
-                                    {"where": thread_name})
-                    now = time.monotonic()
-                    while (restart_times and now - restart_times[0]
-                           > self._restart_window_s):
-                        restart_times.popleft()
-                    if len(restart_times) >= self._max_restarts:
-                        # Budget spent: surface the terminal failure.
-                        # The queue hand-off delivers the exception to
-                        # the driver; the flight-recorder dump preserves
-                        # THIS thread's last moments (ring tail + every
-                        # thread's stack) even if the driver never
-                        # drains it.
-                        recorder.dump_all(
-                            f"exception:{type(exc).__name__}:"
-                            f"{thread_name}")
-                        self._errors.append(exc)
-                        self.queue.put(exc)
-                        return
-                    restart_times.append(now)
-                    in_window = len(restart_times)
-                    backoff = min(
-                        self._restart_backoff_cap_s,
-                        self._restart_backoff_s * 2 ** (in_window - 1))
-                    self._restarts_counter.inc()
-                    recorder.record(
-                        "actor_restart", thread_name,
-                        {"restart": in_window,
-                         "max": self._max_restarts,
-                         "backoff_s": round(backoff, 3),
-                         "error": type(exc).__name__})
-                    log.error(
-                        "actor %s failed (%s: %s) — restart %d/%d in "
-                        "the %.0fs window, retrying in %.2fs",
-                        thread_name, type(exc).__name__, exc, in_window,
-                        self._max_restarts, self._restart_window_s,
-                        backoff)
-                    # Idle backoff is not a wedge; the next unroll's
-                    # touch re-arms the heartbeat.
-                    get_watchdog().suspend()
-                    reset = getattr(actor, "reset", None)
-                    if reset is not None:
-                        try:
-                            reset()
-                        except Exception:
-                            log.exception(
-                                "actor %s reset failed before retry",
-                                thread_name)
-                    self._stop.wait(backoff)
-        finally:
-            get_watchdog().suspend()
+        run_with_retry(
+            lambda: self._unroll_loop(actor),
+            stop=self._stop, deliver=deliver,
+            reset=getattr(actor, "reset", None),
+            max_restarts=self._max_restarts,
+            backoff_s=self._restart_backoff_s,
+            backoff_cap_s=self._restart_backoff_cap_s,
+            window_s=self._restart_window_s,
+            restarts_counter=self._restarts_counter)
 
     def start(self):
         if self._params is None:
@@ -698,20 +803,10 @@ class ActorPool:
         return self
 
     def get_trajectory(self, timeout: Optional[float] = None) -> ActorOutput:
-        with get_tracer().span("batcher/queue_get", cat="queue"):
-            item = self.queue.get(timeout=timeout)
-        get_flight_recorder().record("queue", "get")
-        if isinstance(item, Exception):
-            raise item
-        # Ledger hand-off: recover the provenance record bound to this
-        # object and make it the consuming thread's CURRENT record, so
-        # the transport/learner layers downstream stamp the right one.
-        ledger = get_ledger()
-        tid = ledger.lookup(id(item))
-        if tid is not None:
-            ledger.stamp(tid, "queue_get")
-        ledger.set_current(tid)
-        return item
+        # Ledger hand-off inside: recovers the provenance record bound
+        # to the object and makes it the consuming thread's CURRENT
+        # record, so the transport/learner layers stamp the right one.
+        return consume_trajectory(self.queue, timeout=timeout)
 
     def stop(self):
         self._stop.set()
@@ -742,29 +837,9 @@ class ActorPool:
 
     def episode_stats(self):
         """Merged completed-episode (return, length) ring buffers."""
-        stats = []
-        for envs in self._all_envs():
-            stats.extend(envs.episode_stats)
-        return stats
+        return merged_episode_stats(self._all_envs())
 
     def drain_level_stats(self):
         """Pop all level-attributed episodes completed since the last
-        drain: {level_name: [(episode_return, episode_length), ...]}.
-
-        Feeds multi-task per-level metrics and the DMLab-30 training
-        suite score (reference: experiment.py:634-667, which clears the
-        per-level lists after each score — draining gives the same
-        each-episode-counted-once semantics).  popleft is atomic, so
-        actor threads can keep appending during the drain."""
-        by_level = {}
-        for envs in self._all_envs():
-            queue = getattr(envs, "level_episode_stats", None)
-            if not queue:
-                continue
-            while True:
-                try:
-                    level, ret, length = queue.popleft()
-                except IndexError:
-                    break
-                by_level.setdefault(level, []).append((ret, length))
-        return by_level
+        drain (shared implementation: ``drain_level_stats``)."""
+        return drain_level_stats(self._all_envs())

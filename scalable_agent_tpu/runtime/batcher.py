@@ -50,6 +50,39 @@ class BatcherClosedError(RuntimeError):
     """Raised to callers whose requests were cancelled by close()."""
 
 
+# -- shared batch formation ---------------------------------------------------
+# The bucketing policy every batching front-end shares: DynamicBatcher,
+# NativeBatcher, ActorPool's service-mode ladder, and the continuous-
+# batching actor service (runtime/service.py).  One implementation so
+# "how many distinct batch shapes can XLA see" has one answer.
+
+
+def bucket_ladder(maximum: int, minimum: int = 1) -> list:
+    """Power-of-two pad sizes ``[minimum, 2*minimum, ..., maximum]``.
+
+    Padding formed batches up the ladder bounds the set of batch shapes
+    a jitted compute function sees to ~log2(maximum) — the recompile
+    bound the reference solved with static graph shapes
+    (dynamic_batching.py:125-128)."""
+    if maximum < 1:
+        raise ValueError(f"maximum must be >= 1, got {maximum}")
+    sizes = [max(1, min(int(minimum), maximum))]
+    while sizes[-1] < maximum:
+        sizes.append(min(sizes[-1] * 2, maximum))
+    return sizes
+
+
+def pad_to_bucket(n: int, sizes: Optional[Sequence[int]]) -> int:
+    """Smallest bucket in ascending ``sizes`` holding ``n`` valid rows
+    (``n`` itself when no bucket fits or bucketing is disabled)."""
+    if sizes is None:
+        return n
+    for size in sizes:
+        if size >= n:
+            return size
+    return n
+
+
 class _Request:
     __slots__ = ("sample", "future", "enqueued_at")
 
@@ -197,12 +230,7 @@ class DynamicBatcher:
             self._run_batch(batch)
 
     def _pad_rows(self, n: int) -> int:
-        if self._pad_to_sizes is None:
-            return n
-        for size in self._pad_to_sizes:
-            if size >= n:
-                return size
-        return n
+        return pad_to_bucket(n, self._pad_to_sizes)
 
     def _run_batch(self, batch):
         n = len(batch)

@@ -17,6 +17,10 @@ runtime's failure-prone seams —
   integrity manifest + walk-back restore.
 - ``ckpt_save_fail`` (runtime/checkpoint.py): raise inside a cadenced
   save, exercising the log-and-continue degrade path.
+- ``service_stall`` (runtime/service.py): wedge the continuous-batching
+  inference thread for ``SERVICE_STALL_S`` seconds (occurrences count
+  formed batches) — the service's watchdog heartbeat must go stale and
+  dump forensics instead of silently starving the learner.
 - ``peer_exit``  (runtime/fleet.py): ``os._exit(1)`` from the fleet
   monitor cycle — sudden peer death; SURVIVORS must detect the stale
   heartbeat and exit 72.  Occurrences count monitor cycles.

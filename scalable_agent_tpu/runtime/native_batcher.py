@@ -28,7 +28,10 @@ from scalable_agent_tpu.obs import (
     get_tracer,
     get_watchdog,
 )
-from scalable_agent_tpu.runtime.batcher import BatcherClosedError
+from scalable_agent_tpu.runtime.batcher import (
+    BatcherClosedError,
+    pad_to_bucket,
+)
 # One flat-pytree byte layout serves every host-side pytree<->bytes
 # boundary (this batcher's request/result rows and the packed trajectory
 # transport's segments) — runtime/transport.py is the single source of
@@ -147,12 +150,7 @@ class NativeBatcher:
     # -- consumer side -----------------------------------------------------
 
     def _pad_rows(self, n: int) -> int:
-        if self._pad_to_sizes is None:
-            return n
-        for size in self._pad_to_sizes:
-            if size >= n:
-                return size
-        return n
+        return pad_to_bucket(n, self._pad_to_sizes)
 
     def _consume_loop(self):
         sample_nbytes = self._sample_layout.nbytes

@@ -1,0 +1,967 @@
+"""Continuous-batching actor service: no per-step group barrier.
+
+BENCH_r04's verdict (ROADMAP item 1) is a ~200x gap between what the
+learner eats (~2.55M env_frames/s/chip) and what the host pipeline
+delivers (12.6k), and the grouped actor path owns most of it by
+construction: ``MultiEnv.step_recv`` gathers an ENTIRE group each step
+— the slowest env worker gates its whole group — and ``VectorActor``
+alternates env-dispatch → wait → inference, so inference never overlaps
+stepping.  This module replaces that lockstep with the async
+whole-machine design of "Accelerated Methods for Deep RL" (PAPERS.md)
+fused with the reference's dynamic-batcher idea (batcher.cc):
+
+- **Per-worker completion** (envs/vector.py ``worker_send`` /
+  ``worker_recv``): each env worker's observations flow out the moment
+  its reply lands.  A slow worker delays only its own slice.
+- **Request ring**: finished slices push ``(generation, group, worker,
+  observations)`` requests into a lock-free deque (atomic append/pop —
+  the flightrec ring discipline; a condition variable exists only to
+  wake the idle consumer).
+- **One continuous-batching inference thread**: drains WHATEVER is
+  pending — no minimum, no timeout, no barrier — up to
+  ``--service_max_batch`` rows, pads to the shared power-of-two bucket
+  ladder (runtime/batcher.py ``bucket_ladder``/``pad_to_bucket``, the
+  batch-formation core both dynamic batchers use) to bound XLA
+  recompiles, and runs ONE jitted ``actor_step`` whose LSTM states live
+  device-resident in a ``[num_envs + 1, core]`` slab (gathered by env
+  id on the way in, scattered back on the way out; the extra row
+  swallows padding writes).  Per step only observations go up and
+  actions come down — the state never re-crosses the link.
+- **Per-env trajectory packing** (``TrajectoryPacker``): every lane (a
+  worker's env slice — envs that always step together) independently
+  accumulates the reference's T+1 overlap layout and emits a full
+  [T+1, B] ``ActorOutput`` into the existing ActorPool-compatible queue
+  as soon as every lane of a group has an unroll ready, feeding the
+  packed transport unchanged.  A straggler bounds emission cadence,
+  never its siblings' stepping.
+
+Observability: the service feeds the pipeline ledger's ``service_wait``
+(Little's-law L of parked requests) and ``service_batch`` (inference
+thread utilization) stages, ``service/*`` histograms mapped in
+``ledger.TIMING_STAGE_MAP``, and the watchdog (the inference thread
+heartbeats per batch, so a wedged service dumps forensics instead of
+silently starving the learner — chaos point ``service_stall``,
+runtime/faults.py).
+
+Select with ``--actor=service`` (``--actor=grouped`` keeps the lockstep
+pool); docs/performance.md, "Continuous-batching actor service".  This
+is the host-env prong (b) of ROADMAP item 1 and the inference-engine
+skeleton for the item-4 serving path.
+"""
+
+import functools
+import os
+import queue as queue_lib
+import threading
+import time
+from collections import deque
+from multiprocessing import connection as mp_connection
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from scalable_agent_tpu.envs.vector import MultiEnv
+from scalable_agent_tpu.models.agent import ImpalaAgent, actor_step
+from scalable_agent_tpu.obs import (
+    get_flight_recorder,
+    get_ledger,
+    get_registry,
+    get_tracer,
+    get_watchdog,
+)
+from scalable_agent_tpu.obs.ledger import now_us as ledger_now_us
+from scalable_agent_tpu.runtime.actor import (
+    _stack_time,
+    _to_numpy,
+    actor_stage_histograms,
+    consume_trajectory,
+    drain_level_stats,
+    merged_episode_stats,
+    publish_trajectory,
+    run_with_retry,
+    snapshot_params_for_inference,
+)
+from scalable_agent_tpu.runtime.batcher import bucket_ladder, pad_to_bucket
+from scalable_agent_tpu.types import (
+    ActorOutput,
+    AgentOutput,
+    AgentState,
+    map_structure,
+)
+
+__all__ = ["ActorService", "TrajectoryPacker", "SERVICE_STALL_S"]
+
+# How long the ``service_stall`` chaos point wedges the inference
+# thread (runtime/faults.py): long enough to trip a test-sized watchdog
+# deadline, short enough that the run recovers and completes.  The env
+# var is read at FIRE time so tests can tune it after import.
+SERVICE_STALL_S = 2.0
+
+
+def _stall_seconds() -> float:
+    try:
+        return float(os.environ.get("SCALABLE_AGENT_SERVICE_STALL_S",
+                                    SERVICE_STALL_S))
+    except ValueError:
+        return SERVICE_STALL_S
+
+
+def _service_actor_step(agent, params, rng, ids, last_actions,
+                        env_outputs, slab_c, slab_h):
+    """One continuous batch: gather LSTM states by env id from the
+    device-resident slab, run the shared ``actor_step``, scatter the
+    new states back.  ``ids`` pads with the slab's extra dummy row, so
+    padded rows gather junk (discarded) and scatter harmlessly.  The
+    slabs are donated — they never leave the device."""
+    state = AgentState(c=slab_c[ids], h=slab_h[ids])
+    out, new_state = actor_step(agent, params, rng, last_actions,
+                                env_outputs, state)
+    slab_c = slab_c.at[ids].set(new_state.c)
+    slab_h = slab_h.at[ids].set(new_state.h)
+    return out, new_state, slab_c, slab_h
+
+
+class TrajectoryPacker:
+    """Per-lane T+1 overlap trajectory assembly for one env group.
+
+    A *lane* is a contiguous slice of the group's batch whose envs
+    always step together (the service uses one lane per env worker;
+    tests use one env per lane).  Each lane independently accumulates
+    (env_output, agent_output) entry pairs; crossing T steps completes
+    an unroll, which buffers until EVERY lane has one — then ``pop``
+    concatenates lanes into one [T+1, B] batch.
+
+    Layout contract (bit-identical to ``VectorActor``,
+    tests/test_service.py): entry 0 of unroll k+1 is entry T of unroll
+    k; ``agent_state`` is the LSTM state captured AFTER the inference
+    that produced entry T's agent half (``stage_state`` — the caller
+    stages it before dispatching the env step, so the reply can never
+    outrun it).
+
+    Thread model: one producer per lane (stage_inference/stage_state
+    from the inference thread, add_env from the lane's env thread) —
+    per-lane calls strictly alternate because at most one step is ever
+    outstanding per lane.
+    """
+
+    def __init__(self, lane_widths: Sequence[int], unroll_length: int):
+        if unroll_length < 1:
+            raise ValueError("unroll_length must be >= 1")
+        self._T = int(unroll_length)
+        self._widths = [int(w) for w in lane_widths]
+        n = len(self._widths)
+        self._env_entries: List[list] = [[] for _ in range(n)]
+        self._agent_entries: List[list] = [[] for _ in range(n)]
+        self._state = [None] * n          # current unroll boundary state
+        self._staged_agent = [None] * n   # next entry's agent half
+        self._staged_state = [None] * n   # next unroll's boundary state
+        self._unroll_start_us = [0] * n
+        self._completed = [deque() for _ in range(n)]
+
+    @property
+    def num_lanes(self) -> int:
+        return len(self._widths)
+
+    @property
+    def num_envs(self) -> int:
+        return sum(self._widths)
+
+    def lane_width(self, lane: int) -> int:
+        return self._widths[lane]
+
+    def entry_count(self, lane: int) -> int:
+        """Entries in the lane's CURRENT (partial) unroll."""
+        return len(self._env_entries[lane])
+
+    def completed_depth(self, lane: int) -> int:
+        """Finished unrolls buffered for the lane (straggler siblings
+        keep stepping; their output parks here)."""
+        return len(self._completed[lane])
+
+    def bootstrap(self, lane: int, env_tree, agent_tree, c_rows,
+                  h_rows) -> None:
+        """Entry 0 of the lane's first unroll: initial env outputs, a
+        zero agent output, and the zero LSTM state (the reference's
+        persistent-state init, experiment.py:243-251)."""
+        self._env_entries[lane] = [env_tree]
+        self._agent_entries[lane] = [agent_tree]
+        self._state[lane] = (c_rows, h_rows)
+        self._staged_agent[lane] = None
+        self._staged_state[lane] = None
+        self._unroll_start_us[lane] = ledger_now_us()
+
+    def has_staged(self, lane: int) -> bool:
+        """True when the lane has an inference staged and its env step
+        in flight — i.e. a reply is EXPECTED.  A reply landing with
+        nothing staged means the worker died idle and was respawned
+        (the service re-bootstraps just that lane)."""
+        return self._staged_agent[lane] is not None
+
+    def stage_inference(self, lane: int, agent_tree) -> bool:
+        """Record the agent half of the lane's next entry (the
+        inference output whose action the env is about to execute).
+        Returns True when that entry will COMPLETE an unroll — the
+        caller must ``stage_state`` before dispatching the env step."""
+        if self._staged_agent[lane] is not None:
+            raise RuntimeError(
+                f"lane {lane}: staging a second inference with one "
+                f"already outstanding (protocol violation)")
+        self._staged_agent[lane] = agent_tree
+        return len(self._env_entries[lane]) == self._T
+
+    def stage_state(self, lane: int, c_rows, h_rows) -> None:
+        """The post-inference LSTM state rows that become the NEXT
+        unroll's ``agent_state`` (may be lazy device arrays — ``pop``
+        materializes them)."""
+        self._staged_state[lane] = (c_rows, h_rows)
+
+    def add_env(self, lane: int, env_tree) -> bool:
+        """Pair the env reply with the staged agent half into one
+        entry.  Returns True when the lane completed an unroll."""
+        agent_tree = self._staged_agent[lane]
+        if agent_tree is None:
+            raise RuntimeError(
+                f"lane {lane}: env reply with no staged inference "
+                f"(protocol violation)")
+        self._staged_agent[lane] = None
+        self._env_entries[lane].append(env_tree)
+        self._agent_entries[lane].append(agent_tree)
+        if len(self._env_entries[lane]) <= self._T:
+            return False
+        staged = self._staged_state[lane]
+        if staged is None:
+            raise RuntimeError(
+                f"lane {lane}: unroll completed without a staged "
+                f"boundary state")
+        self._completed[lane].append(
+            (self._unroll_start_us[lane], self._state[lane],
+             self._env_entries[lane], self._agent_entries[lane]))
+        # T+1 overlap: the completed unroll's last entry seeds the next.
+        self._env_entries[lane] = [env_tree]
+        self._agent_entries[lane] = [agent_tree]
+        self._state[lane] = staged
+        self._staged_state[lane] = None
+        self._unroll_start_us[lane] = ledger_now_us()
+        return True
+
+    def ready(self) -> bool:
+        return all(self._completed)
+
+    def pop(self):
+        """One [T+1, B] batch: the oldest completed unroll of every
+        lane, concatenated in lane (= batch) order.  Returns
+        ``(birth_us, agent_state, env_outputs, agent_outputs)`` where
+        ``birth_us`` is the OLDEST lane's unroll start — the
+        conservative staleness anchor."""
+        births, cs, hs, env_trees, agent_trees = [], [], [], [], []
+        for lane in range(self.num_lanes):
+            birth, (c, h), env_rows, agent_rows = (
+                self._completed[lane].popleft())
+            births.append(birth)
+            cs.append(np.asarray(c))
+            hs.append(np.asarray(h))
+            env_trees.append(_stack_time(env_rows))
+            agent_trees.append(_stack_time(agent_rows))
+
+        def join(*xs):
+            return (None if xs[0] is None
+                    else np.concatenate(xs, axis=1))
+
+        return (
+            min(births),
+            AgentState(c=np.concatenate(cs), h=np.concatenate(hs)),
+            map_structure(join, *env_trees),
+            map_structure(join, *agent_trees),
+        )
+
+    def reset(self) -> None:
+        """Drop ALL lane state (partial entries, staged halves,
+        buffered unrolls) after a mid-unroll failure: the retry path
+        re-bootstraps from fresh initial outputs, exactly like
+        ``VectorActor.reset``."""
+        n = self.num_lanes
+        self._env_entries = [[] for _ in range(n)]
+        self._agent_entries = [[] for _ in range(n)]
+        self._state = [None] * n
+        self._staged_agent = [None] * n
+        self._staged_state = [None] * n
+        self._completed = [deque() for _ in range(n)]
+
+
+class _Request:
+    """One worker slice's pending inference request.  Three staleness
+    stamps, all checked under the worker lock before dispatch: ``gen``
+    is the group generation (bumped by a full group reset),
+    ``lane_gen`` the per-lane generation (bumped when a lane alone
+    re-bootstraps after an idle worker death), and ``env_gen`` the
+    worker's RESPAWN generation (MultiEnv.worker_generation — a
+    respawn's _INITIAL prime already has a reply in flight, so a
+    request predating the respawn must be discarded, not dispatched on
+    top of it)."""
+
+    __slots__ = ("gen", "lane_gen", "env_gen", "group", "worker",
+                 "env_tree", "submitted_us")
+
+    def __init__(self, gen, lane_gen, env_gen, group, worker, env_tree,
+                 submitted_us):
+        self.gen = gen
+        self.lane_gen = lane_gen
+        self.env_gen = env_gen
+        self.group = group
+        self.worker = worker
+        self.env_tree = env_tree
+        self.submitted_us = submitted_us
+
+
+class _Group:
+    """Per-group bookkeeping: envs, packer, global env offset, and the
+    generation counter that invalidates in-flight requests across a
+    retry reset."""
+
+    __slots__ = ("envs", "packer", "offset", "slices", "gen",
+                 "lane_gen", "sent_at", "poisoned")
+
+    def __init__(self, envs: MultiEnv, packer: TrajectoryPacker,
+                 offset: int):
+        self.envs = envs
+        self.packer = packer
+        self.offset = offset
+        # Immutable after MultiEnv construction — cached so the hot
+        # batch loops don't allocate a fresh list per request.
+        self.slices = envs.worker_slices()
+        self.gen = 0
+        self.lane_gen = [0] * envs.num_workers
+        self.sent_at = [0.0] * envs.num_workers
+        # An exception the inference thread hit dispatching to THIS
+        # group (e.g. its worker's respawn budget raising inside
+        # worker_send): marshalled here so the group's OWN retry shell
+        # — the layer with the reset + budget semantics — absorbs it,
+        # instead of the inference thread retrying the wrong resource.
+        self.poisoned: Optional[BaseException] = None
+
+
+class ActorService:
+    """Continuous-batching actor service (``--actor=service``).
+
+    Drop-in for ``ActorPool`` on the driver's side: same queue/
+    ``set_params``/``start``/``get_trajectory``/``stop``/stats surface,
+    same [T+1, B] ``ActorOutput`` batches.  Internally there is no
+    group lockstep: env worker threads stream per-worker observations
+    into a request ring, one inference thread continuously batches
+    whatever arrived against a device-resident LSTM state slab, and
+    per-lane packers assemble trajectories (module docstring).
+    """
+
+    def __init__(
+        self,
+        agent: ImpalaAgent,
+        env_groups: Sequence[MultiEnv],
+        unroll_length: int,
+        level_name: str = "",
+        seed: int = 0,
+        queue_capacity: Optional[int] = None,
+        inference_device: Optional[jax.Device] = None,
+        max_batch: int = 0,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.5,
+        restart_backoff_cap_s: float = 30.0,
+        restart_window_s: float = 600.0,
+    ):
+        if not env_groups:
+            raise ValueError("ActorService needs at least one env group")
+        self._agent = agent
+        self._unroll_length = int(unroll_length)
+        self.level_name = level_name
+        self._inference_device = inference_device or jax.local_devices()[0]
+        self._rng = jax.random.key(seed)
+        self._batch_counter = 0
+
+        offset = 0
+        self._groups: List[_Group] = []
+        widest = 1
+        for envs in env_groups:
+            widths = [sl.stop - sl.start for sl in envs.worker_slices()]
+            widest = max(widest, *widths)
+            self._groups.append(_Group(
+                envs, TrajectoryPacker(widths, unroll_length), offset))
+            offset += envs.num_envs
+        self._num_envs = offset
+        # The dummy slab row padding rows gather from / scatter into.
+        self._dummy_slot = self._num_envs
+        if max_batch and max_batch < widest:
+            raise ValueError(
+                f"service_max_batch {max_batch} is smaller than the "
+                f"widest worker slice ({widest} envs) — requests are "
+                f"slice-granular")
+        self._max_batch = int(max_batch) or self._num_envs
+        self._buckets = bucket_ladder(self._max_batch)
+
+        # Device-resident per-env LSTM state: [N + 1, core] (the +1 row
+        # swallows padded scatter writes).  Donated through every
+        # batch, so the state never re-crosses the link.
+        zeros = np.zeros((self._num_envs + 1, agent.core_size),
+                         np.float32)
+        self._slab_c = jax.device_put(zeros, self._inference_device)
+        self._slab_h = jax.device_put(zeros.copy(),
+                                      self._inference_device)
+        # Host-side last sampled action per env (the next inference's
+        # ``last_action`` input).
+        self._last_actions = np.asarray(
+            agent.zero_actions(self._num_envs)).copy()
+        self._step_fn = jax.jit(
+            functools.partial(_service_actor_step, agent),
+            donate_argnums=(5, 6))
+
+        # Lock-free request ring (deque append/popleft are atomic); the
+        # condition only wakes the idle inference thread.
+        self._ring: deque = deque()
+        self._ring_cond = threading.Condition()
+
+        self.queue = queue_lib.Queue(
+            maxsize=queue_capacity or len(env_groups))
+        self._params = None
+        self._params_version = 0
+        self._params_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._errors: List[BaseException] = []
+        self._max_restarts = max(0, int(max_restarts))
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self._restart_window_s = float(restart_window_s)
+
+        # Observability: the pool-compatible gauges keep driver
+        # dashboards working unchanged; the service/* instruments are
+        # this path's own (ledger TIMING_STAGE_MAP maps them).  Weak
+        # references only — the registry must never keep a stopped
+        # service (and its queued trajectories) alive.
+        import weakref
+
+        registry = get_registry()
+        queue_ref = weakref.ref(self.queue)
+        registry.gauge(
+            "actor_pool/queue_depth",
+            "trajectories staged for the learner",
+            fn=lambda: (q.qsize() if (q := queue_ref()) is not None
+                        else 0.0))
+        registry.gauge(
+            "actor_pool/queue_capacity",
+            "trajectory queue bound").set(self.queue.maxsize)
+        self_ref = weakref.ref(self)
+        registry.gauge(
+            "actor_pool/params_version",
+            "newest published weight snapshot",
+            fn=lambda: (s._params_version if (s := self_ref()) is not None
+                        else 0.0))
+        ring_ref = weakref.ref(self._ring)
+        registry.gauge(
+            "service/pending_requests",
+            "worker slices parked in the request ring",
+            fn=lambda: (len(r) if (r := ring_ref()) is not None
+                        else 0.0))
+        self._frames_counter = registry.counter(
+            "actor/agent_steps_total",
+            "agent steps generated across all groups (x action repeats "
+            "= env frames)")
+        self._trajectories_counter = registry.counter(
+            "actor/trajectories_total", "unrolls handed to the queue")
+        self._restarts_counter = registry.counter(
+            "actor/restarts_total",
+            "actor-thread respawns after a transient failure (the "
+            "per-actor detail rides the flight recorder's "
+            "actor_restart events)")
+        self._h_env, self._h_infer = actor_stage_histograms(registry)
+        self._h_wait = registry.histogram(
+            "service/wait_s",
+            "request submission -> batch formation seconds (the "
+            "ledger's service_wait stage)")
+        self._h_batch = registry.histogram(
+            "service/batch_s",
+            "batched inference execution seconds per service batch "
+            "(the ledger's service_batch stage)")
+        self._h_latency = registry.histogram(
+            "service/request_latency_s",
+            "request submission -> action dispatched seconds")
+        self._h_batch_size = registry.histogram(
+            "service/batch_size", "valid rows per service batch")
+        self._h_occupancy = registry.histogram(
+            "service/occupancy",
+            "valid rows / service_max_batch per service batch")
+        self._batches_counter = registry.counter(
+            "service/batches_total", "service batches executed")
+        self._frames_per_trajectory = (
+            unroll_length * env_groups[0].num_envs)
+
+    # -- weight publication ------------------------------------------------
+
+    def set_params(self, params, version: Optional[int] = None):
+        """Publish a private single-device weight snapshot for
+        subsequent batches (same re-placement contract as
+        ActorPool.set_params — ``snapshot_params_for_inference``)."""
+        params = snapshot_params_for_inference(params,
+                                               self._inference_device)
+        with self._params_lock:
+            self._params = params
+            self._params_version = (
+                version if version is not None
+                else self._params_version + 1)
+
+    def _get_params(self):
+        with self._params_lock:
+            return self._params
+
+    # -- env side ----------------------------------------------------------
+
+    def _submit(self, request: _Request) -> None:
+        self._ring.append(request)
+        with self._ring_cond:
+            self._ring_cond.notify()
+
+    def _bootstrap_lane(self, gi: int, w: int, out) -> None:
+        """Entry 0 for ONE lane from its (initial) slice outputs: zero
+        agent output + zero LSTM state (VectorActor._bootstrap's
+        layout), plus the lane's first inference request."""
+        group = self._groups[gi]
+        sl = group.slices[w]
+        k = sl.stop - sl.start
+        zero_agent = AgentOutput(
+            action=np.asarray(self._agent.zero_actions(k)),
+            policy_logits=np.zeros(
+                (k, self._agent.num_logits), np.float32),
+            baseline=np.zeros((k,), np.float32))
+        zeros = np.zeros((k, self._agent.core_size), np.float32)
+        group.packer.bootstrap(w, out, zero_agent, zeros, zeros.copy())
+        self._last_actions[group.offset + sl.start:
+                           group.offset + sl.stop] = zero_agent.action
+        self._submit(_Request(group.gen, group.lane_gen[w],
+                              group.envs.worker_generation(w), gi, w,
+                              out, ledger_now_us()))
+
+    def _bootstrap_group(self, gi: int) -> None:
+        """(Re)start one group: fresh initial outputs and entry 0 per
+        worker slice."""
+        group = self._groups[gi]
+        envs = group.envs
+        group.packer.reset()
+        for w in range(envs.num_workers):
+            self._bootstrap_lane(gi, w, envs.worker_initial(w))
+
+    def _reset_group(self, gi: int) -> None:
+        """Retry-path reset: invalidate in-flight requests (generation
+        bump), wait out any straddling send (lock cycle), drain stale
+        pipe replies, drop partial trajectories.  The next loop pass
+        re-bootstraps."""
+        group = self._groups[gi]
+        group.gen += 1
+        for w in range(group.envs.num_workers):
+            # A send dispatched under the OLD generation must finish
+            # before the drain, or its reply arrives after and desyncs.
+            with group.envs.worker_lock(w):
+                pass
+        group.envs.resync()
+        group.packer.reset()
+
+    def _chaos_kill_worker(self, envs: MultiEnv) -> None:
+        """``worker_kill`` injection: SIGKILL one env worker process —
+        the per-worker respawn machinery must absorb it."""
+        procs = getattr(envs, "_procs", None)
+        if not procs:
+            return
+        proc = procs[0]
+        if proc is not None and proc.is_alive():
+            from scalable_agent_tpu.utils import log
+
+            log.warning("chaos: killing env worker pid %d", proc.pid)
+            proc.kill()
+
+    def _group_loop(self, gi: int) -> None:
+        """One group's steady-state env side: bootstrap, then stream
+        per-worker replies into the ring as they land (runs under the
+        shared retry shell; exceptions reset + re-bootstrap)."""
+        from scalable_agent_tpu.runtime.faults import get_fault_injector
+
+        group = self._groups[gi]
+        envs = group.envs
+        watchdog = get_watchdog()
+        self._bootstrap_group(gi)
+        while not self._stop.is_set():
+            # Bounded waits below re-touch, so the heartbeat only goes
+            # stale when this thread truly wedges.
+            watchdog.touch()
+            if group.poisoned is not None:
+                # The inference thread failed dispatching to this
+                # group: surface it HERE so this thread's retry shell
+                # resets and re-bootstraps the group.
+                exc, group.poisoned = group.poisoned, None
+                raise exc
+            injector = get_fault_injector()
+            if injector.active:
+                injector.maybe_raise("actor_raise")
+                if injector.should_fire("worker_kill"):
+                    self._chaos_kill_worker(envs)
+            # Re-read the conns each pass: a respawn replaces them.
+            conns = [envs.worker_connection(w)
+                     for w in range(envs.num_workers)]
+            try:
+                ready = mp_connection.wait(conns, timeout=0.1)
+            except (OSError, ValueError):
+                # A conn in the snapshot was closed mid-wait by a
+                # concurrent respawn (the inference thread's
+                # worker_send hit the dead pipe first) — refresh the
+                # snapshot next pass instead of treating a routine
+                # worker death as a group failure.
+                continue
+            for conn in ready:
+                if self._stop.is_set():
+                    return
+                w = conns.index(conn)
+                out = envs.worker_recv(w)
+                sent_at = group.sent_at[w]
+                if sent_at:
+                    self._h_env.observe(time.monotonic() - sent_at)
+                self._handle_reply(gi, w, out)
+
+    def _handle_reply(self, gi: int, w: int, out) -> None:
+        group = self._groups[gi]
+        # The whole classify-and-consume step runs under the worker
+        # lock — the same lock the inference thread stages/dispatches
+        # under — so "nothing staged" is judged against a SETTLED lane:
+        # either the parked request already staged (normal pairing
+        # below) or the lane-gen bump here invalidates it before the
+        # inference thread can dispatch it.
+        with group.envs.worker_lock(w):
+            if not group.packer.has_staged(w):
+                # A reply with no inference staged: the worker died
+                # IDLE (its request parked in the ring, no step in
+                # flight) and worker_recv respawned it — ``out`` is its
+                # fresh initial slice.  Recover at LANE granularity,
+                # like the grouped path's respawn: invalidate the stale
+                # parked request (lane generation bump) and
+                # re-bootstrap just this lane, without resetting
+                # siblings or burning the group restart budget.
+                group.lane_gen[w] += 1
+                self._bootstrap_lane(gi, w, out)
+                return
+            completed = group.packer.add_env(w, out)
+            # The reply is BOTH trajectory entry t and inference input
+            # for entry t+1 (the VectorActor loop's data flow,
+            # barrier-free).
+            self._submit(_Request(group.gen, group.lane_gen[w],
+                                  group.envs.worker_generation(w),
+                                  gi, w, out, ledger_now_us()))
+        if completed:
+            self._maybe_emit(gi)
+
+    def _maybe_emit(self, gi: int) -> None:
+        group = self._groups[gi]
+        thread_name = threading.current_thread().name
+        while group.packer.ready():
+            birth_us, agent_state, env_outputs, agent_outputs = (
+                group.packer.pop())
+            trajectory = ActorOutput(
+                level_name=self.level_name,
+                agent_state=agent_state,
+                env_outputs=env_outputs,
+                agent_outputs=agent_outputs)
+            get_flight_recorder().record(
+                "unroll", self.level_name or "actor",
+                {"trajectories": 1, "service": True})
+            publish_trajectory(
+                self.queue, trajectory, self._stop,
+                actor_name=thread_name,
+                level_name=self.level_name,
+                birth_us=birth_us,
+                frames=self._frames_per_trajectory,
+                frames_counter=None,  # counted per batch row instead
+                trajectories_counter=self._trajectories_counter)
+
+    # -- inference side ----------------------------------------------------
+
+    def _take_requests(self) -> Optional[List[_Request]]:
+        """Continuous batch formation: block until at least one request
+        exists, then take whatever else is already pending up to
+        ``max_batch`` rows — no minimum, no flush timeout, no barrier.
+        Returns None at stop."""
+        watchdog = get_watchdog()
+        while not self._stop.is_set():
+            try:
+                first = self._ring.popleft()
+            except IndexError:
+                # Idle is not a wedge; re-arm for the batch below.
+                watchdog.suspend()
+                with self._ring_cond:
+                    self._ring_cond.wait(0.2)
+                watchdog.touch()
+                continue
+            requests = [first]
+            total = self._request_rows(first)
+            while total < self._max_batch:
+                try:
+                    nxt = self._ring.popleft()
+                except IndexError:
+                    break
+                rows = self._request_rows(nxt)
+                if total + rows > self._max_batch:
+                    self._ring.appendleft(nxt)
+                    break
+                requests.append(nxt)
+                total += rows
+            return requests
+        return None
+
+    def _request_rows(self, request: _Request) -> int:
+        return self._groups[request.group].packer.lane_width(
+            request.worker)
+
+    def _inference_loop(self) -> None:
+        """The service thread: drain → pad → one jitted step → stream
+        actions back per worker slice.  Runs under the retry shell; a
+        failed batch's requests are re-queued first so its envs cannot
+        starve across the retry."""
+        from scalable_agent_tpu.runtime.faults import get_fault_injector
+
+        watchdog = get_watchdog()
+        while not self._stop.is_set():
+            requests = self._take_requests()
+            if requests is None:
+                return
+            watchdog.touch()
+            injector = get_fault_injector()
+            if injector.active and injector.should_fire("service_stall"):
+                from scalable_agent_tpu.utils import log
+
+                stall = _stall_seconds()
+                log.warning("chaos: service inference thread stalling "
+                            "%.1fs", stall)
+                time.sleep(stall)
+            self._run_batch(requests)
+
+    def _reset_inference(self) -> None:
+        """Inference-retry reset: a device call that failed AFTER its
+        donation invalidated the state slabs would otherwise make every
+        retry fail on the deleted buffers — rebuild them as zeros (the
+        done-reset restores per-env state at each episode boundary)."""
+        deleted = any(
+            getattr(slab, "is_deleted", lambda: False)()
+            for slab in (self._slab_c, self._slab_h))
+        if deleted:
+            zeros = np.zeros((self._num_envs + 1, self._agent.core_size),
+                             np.float32)
+            self._slab_c = jax.device_put(zeros, self._inference_device)
+            self._slab_h = jax.device_put(zeros.copy(),
+                                          self._inference_device)
+
+    def _run_batch(self, requests: List[_Request]) -> None:
+        start_us = ledger_now_us()
+        t0 = time.monotonic()
+        # Drop requests a group reset or lane re-bootstrap invalidated
+        # (their staging would pollute the freshly bootstrapped packer).
+        # This unlocked read is a fast filter; the authoritative check
+        # re-runs under the worker lock in the dispatch pass below.
+        live = [r for r in requests
+                if (r.gen == self._groups[r.group].gen
+                    and r.lane_gen
+                    == self._groups[r.group].lane_gen[r.worker]
+                    and r.env_gen
+                    == self._groups[r.group].envs.worker_generation(
+                        r.worker))]
+        if not live:
+            return
+        wait_sum = 0.0
+        for request in live:
+            wait = max(0.0, (start_us - request.submitted_us) / 1e6)
+            wait_sum += wait
+            self._h_wait.observe(wait)
+
+        n = sum(self._request_rows(r) for r in live)
+        padded = pad_to_bucket(n, self._buckets)
+        ids = np.full((padded,), self._dummy_slot, np.int32)
+        action_rows = []
+        row = 0
+        for request in live:
+            group = self._groups[request.group]
+            sl = group.slices[request.worker]
+            lo = group.offset + sl.start
+            hi = group.offset + sl.stop
+            ids[row:row + hi - lo] = np.arange(lo, hi, dtype=np.int32)
+            action_rows.append(self._last_actions[lo:hi])
+            row += hi - lo
+
+        def join(*leaves):
+            if leaves[0] is None:
+                return None
+            arr = np.concatenate([np.asarray(x) for x in leaves])
+            if padded > n:
+                arr = np.pad(arr, [(0, padded - n)]
+                             + [(0, 0)] * (arr.ndim - 1))
+            return arr
+
+        env_batch = map_structure(join,
+                                  *[r.env_tree for r in live])
+        actions = join(*action_rows)
+
+        self._batch_counter += 1
+        rng = jax.random.fold_in(self._rng, self._batch_counter)
+        try:
+            with get_tracer().span("service/batch", cat="actor",
+                                   args={"n": n, "padded": padded}):
+                out, new_state, self._slab_c, self._slab_h = (
+                    self._step_fn(
+                        self._get_params(), rng, ids, actions,
+                        env_batch, self._slab_c, self._slab_h))
+                out_np = _to_numpy(out)
+        except BaseException:
+            # The batch died BEFORE any action dispatched: its envs
+            # have no step in flight, so park the requests for the
+            # retried loop (front of the ring, oldest first).  Failures
+            # past this point dispatched for some slices already — the
+            # env threads' own retry resets recover those groups.
+            for request in reversed(requests):
+                self._ring.appendleft(request)
+            raise
+        exec_s = time.monotonic() - t0
+        self._h_batch.observe(exec_s)
+        self._h_infer.observe(exec_s)
+        self._h_batch_size.observe(n)
+        self._h_occupancy.observe(n / self._max_batch)
+        self._batches_counter.inc()
+        self._frames_counter.inc(n)
+        ledger = get_ledger()
+        ledger.note_service("service_batch", n, exec_s)
+        ledger.note_service("service_wait", n, wait_sum)
+
+        # Stage each slice's agent half (and, at unroll boundaries, its
+        # post-inference LSTM state rows), THEN dispatch its env step —
+        # all under the worker lock, gen-checked, so a reply can never
+        # outrun its staged state and a group reset can never interleave
+        # a stale send.
+        done_us = ledger_now_us()
+        row = 0
+        for request in live:
+            group = self._groups[request.group]
+            sl = group.slices[request.worker]
+            k = sl.stop - sl.start
+            rows = slice(row, row + k)
+            row += k
+            agent_tree = AgentOutput(
+                action=out_np.action[rows],
+                policy_logits=out_np.policy_logits[rows],
+                baseline=out_np.baseline[rows])
+            try:
+                with group.envs.worker_lock(request.worker):
+                    if (group.gen != request.gen
+                            or group.lane_gen[request.worker]
+                            != request.lane_gen
+                            or group.envs.worker_generation(
+                                request.worker) != request.env_gen):
+                        # Stale by group reset, lane re-bootstrap, or a
+                        # worker respawn whose _INITIAL prime already
+                        # has a reply in flight — dispatching would
+                        # double-book the request/reply protocol.
+                        continue
+                    need_state = group.packer.stage_inference(
+                        request.worker, agent_tree)
+                    if need_state:
+                        # Lazy device slices: materialized (np.asarray)
+                        # at pop time, so the hot loop never syncs on
+                        # them.
+                        group.packer.stage_state(
+                            request.worker,
+                            new_state.c[rows], new_state.h[rows])
+                    lo = group.offset + sl.start
+                    self._last_actions[lo:lo + k] = agent_tree.action
+                    group.sent_at[request.worker] = time.monotonic()
+                    group.envs.worker_send(request.worker,
+                                           agent_tree.action)
+            except Exception as exc:
+                # Per-request isolation: a dispatch failure (e.g. the
+                # worker's respawn budget raising in worker_send) must
+                # not starve the OTHER co-batched lanes — poison the
+                # owning group so ITS retry shell (the layer with the
+                # reset + budget semantics) absorbs the error, and keep
+                # dispatching the rest of the batch.
+                get_flight_recorder().record(
+                    "exception", type(exc).__name__,
+                    {"where": f"service-dispatch:g{request.group}"
+                              f"w{request.worker}"})
+                group.poisoned = exc
+                continue
+            self._h_latency.observe(
+                max(0.0, (done_us - request.submitted_us) / 1e6))
+
+    # -- run ---------------------------------------------------------------
+
+    def start(self) -> "ActorService":
+        if self._params is None:
+            raise RuntimeError("set_params before start")
+        for gi in range(len(self._groups)):
+
+            def deliver(exc):
+                self._errors.append(exc)
+                self.queue.put(exc)
+
+            def group_main(gi=gi, deliver=deliver):
+                run_with_retry(
+                    lambda: self._group_loop(gi),
+                    stop=self._stop, deliver=deliver,
+                    reset=lambda: self._reset_group(gi),
+                    max_restarts=self._max_restarts,
+                    backoff_s=self._restart_backoff_s,
+                    backoff_cap_s=self._restart_backoff_cap_s,
+                    window_s=self._restart_window_s,
+                    restarts_counter=self._restarts_counter)
+
+            thread = threading.Thread(
+                target=group_main, daemon=True,
+                name=f"service-env-{gi}")
+            thread.start()
+            self._threads.append(thread)
+
+        def deliver_inference(exc):
+            self._errors.append(exc)
+            self.queue.put(exc)
+
+        def inference_main():
+            run_with_retry(
+                self._inference_loop,
+                stop=self._stop, deliver=deliver_inference,
+                reset=self._reset_inference,
+                max_restarts=self._max_restarts,
+                backoff_s=self._restart_backoff_s,
+                backoff_cap_s=self._restart_backoff_cap_s,
+                window_s=self._restart_window_s,
+                restarts_counter=self._restarts_counter)
+
+        thread = threading.Thread(target=inference_main, daemon=True,
+                                  name="service-inference")
+        thread.start()
+        self._threads.append(thread)
+        return self
+
+    def get_trajectory(self, timeout: Optional[float] = None
+                       ) -> ActorOutput:
+        return consume_trajectory(self.queue, timeout=timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._ring_cond:
+            self._ring_cond.notify_all()
+        for thread in self._threads:
+            thread.join(timeout=10)
+        for group in self._groups:
+            group.envs.close()
+
+    # -- stats (the ActorPool surface the driver reads) --------------------
+
+    @property
+    def num_envs(self) -> int:
+        return self._num_envs
+
+    def episode_stats(self):
+        return merged_episode_stats(g.envs for g in self._groups)
+
+    def drain_level_stats(self):
+        """Pop all level-attributed episodes completed since the last
+        drain (the implementation shared with ActorPool)."""
+        return drain_level_stats(g.envs for g in self._groups)

@@ -305,6 +305,84 @@ class TestTransportRegressionGuard:
                     if "TRANSPORT REGRESSION" in e]
 
 
+class TestServiceRegressionGuard:
+    """ISSUE 10 satellite: the continuous-batching actor service must
+    stay at least as fast as the grouped pool at equal env count
+    (hermetic — diag dicts are synthesized)."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_service_slower_than_grouped_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "service_vs_grouped": 0.7,
+                "service_env_frames_per_sec": 7000.0,
+                "grouped_env_frames_per_sec": 10000.0}
+        bench.service_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert any("SERVICE" in e and "0.70x" in e
+                   for e in diag["errors"])
+
+    def test_healthy_run_is_silent(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "service_vs_grouped": 2.4,
+                "service_env_frames_per_sec": 24000.0,
+                "grouped_env_frames_per_sec": 10000.0,
+                "service_request_to_action_p99_us": 900.0}
+        bench.service_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_cpu_fallback_warns_instead_of_failing(self, tmp_path):
+        diag = {"errors": [], "platform": "cpu",
+                "service_vs_grouped": 0.6,
+                "service_env_frames_per_sec": 600.0,
+                "grouped_env_frames_per_sec": 1000.0}
+        bench.service_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == []
+        assert any("advisory" in w for w in diag["warnings"])
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, service_vs_grouped=2.0,
+            service_env_frames_per_sec=20000.0,
+            service_request_to_action_p99_us=800.0)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.service_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "missing this round" in e]
+        assert len(missing) == 3
+
+    def test_silent_when_stage_never_ran_anywhere(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.service_regression_guard(
+            diag, bench_dir=self._write_prev(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        """A CPU fallback round must not be held to a TPU round's
+        published keys."""
+        bench_dir = self._write_prev(tmp_path, service_vs_grouped=2.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.service_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_runs_against_real_committed_artifacts(self):
+        """Against the repo's own BENCH_r*.json: rounds predating the
+        service keys must compare nothing and never crash."""
+        diag = {"errors": [], "platform": "tpu",
+                "service_vs_grouped": 2.0}
+        bench.service_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "SERVICE REGRESSION" in e]
+
+
 class TestResilienceRegressionGuard:
     """ISSUE 4 satellite: the finite-check budget guard (<1% of the
     update stage) fails on TPU, warns on the CPU fallback, and stays

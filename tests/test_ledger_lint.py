@@ -145,3 +145,24 @@ def test_lint_actually_sees_the_known_sites():
     assert "transport/pack_s" in exact
     assert "checkpoint/save_s" in exact
     assert "/request_latency_s" in suffixes
+    # The continuous-batching actor service's timing stages
+    # (runtime/service.py) are pipeline stages by construction — the
+    # walker must see them AND they must map into the ledger.
+    assert "service/wait_s" in exact
+    assert "service/batch_s" in exact
+    assert "service/request_latency_s" in exact
+    assert TIMING_STAGE_MAP["service/wait_s"] == "service_wait"
+    assert TIMING_STAGE_MAP["service/batch_s"] == "service_batch"
+
+
+def test_service_sites_come_from_the_service_module():
+    """Coverage extends to runtime/service.py specifically: the
+    service histograms must be registered THERE (a move elsewhere
+    should be a deliberate map/lint update, not silent drift)."""
+    sites = collect_timing_sites()
+    service_files = {
+        rel for rel, _, kind, name in sites
+        if name.startswith("service/")
+    }
+    assert service_files == {os.path.join("runtime", "service.py")}, (
+        service_files)

@@ -1,0 +1,590 @@
+"""Continuous-batching actor service (runtime/service.py, ISSUE 10).
+
+Covers the three satellite contracts plus the tier-1 driver smoke:
+
+- shared batch formation (bucket_ladder / pad_to_bucket — the code
+  lifted out of both dynamic batchers);
+- per-env trajectory packing: T+1 overlap layout BIT-IDENTICAL to
+  ``VectorActor`` (the packer replays a VectorActor run's per-step rows
+  in scrambled arrival order and must reproduce its trajectories
+  exactly), stragglers buffer without stalling siblings, and a reset
+  forces a fresh bootstrap;
+- MultiEnv's per-worker async step API (slice outputs match the
+  lockstep path; dead workers respawn per worker);
+- the live service: learner-consumable [T+1, B] batches, worker_kill
+  respawn mid-unroll, and the ``service_stall`` chaos point tripping
+  the watchdog heartbeat;
+- driver smoke: ``--actor=service`` end-to-end on the fake env with a
+  complete, conservation-checked ledger artifact carrying the new
+  ``service_*`` stages.
+"""
+
+import functools
+import glob
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import MultiEnv, make_impala_stream
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.models import ImpalaAgent
+from scalable_agent_tpu.models import agent as agent_mod
+from scalable_agent_tpu.runtime import VectorActor
+from scalable_agent_tpu.runtime.batcher import (
+    DynamicBatcher,
+    bucket_ladder,
+    pad_to_bucket,
+)
+from scalable_agent_tpu.runtime.service import (
+    ActorService,
+    TrajectoryPacker,
+)
+from scalable_agent_tpu.types import AgentState, map_structure
+
+NUM_ACTIONS = 5
+FRAME = TensorSpec((16, 16, 3), np.uint8, "frame")
+T = 5
+B = 4
+
+
+def make_envs(n=B, workers=2, seed_base=0):
+    fns = [functools.partial(make_impala_stream, "fake_small",
+                             seed=seed_base + i,
+                             num_actions=NUM_ACTIONS)
+           for i in range(n)]
+    return MultiEnv(fns, FRAME, num_workers=workers)
+
+
+@pytest.fixture(scope="module")
+def agent_and_params():
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+    envs = make_envs(1, workers=1)
+    try:
+        params = agent.init(
+            jax.random.key(0),
+            np.zeros((1, 1), np.int32),
+            jax.tree_util.tree_map(
+                lambda x: None if x is None else np.asarray(x)[None][:, :1],
+                envs.initial(), is_leaf=lambda x: x is None),
+            agent_mod.initial_state(1))
+    finally:
+        envs.close()
+    return agent, params
+
+
+def tree_as_numpy(tree):
+    return jax.tree_util.tree_map(
+        lambda x: None if x is None else np.asarray(x), tree,
+        is_leaf=lambda x: x is None)
+
+
+def assert_trees_equal(a, b, msg=""):
+    def check(x, y):
+        if x is None or y is None:
+            assert x is None and y is None, msg
+            return None
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+        return None
+
+    map_structure(check, a, b)
+
+
+# ---------------------------------------------------------------------------
+# Shared batch formation (lifted out of batcher.py / native_batcher.py)
+# ---------------------------------------------------------------------------
+
+
+class TestBatchFormation:
+    def test_bucket_ladder_powers_of_two(self):
+        assert bucket_ladder(8) == [1, 2, 4, 8]
+        assert bucket_ladder(6) == [1, 2, 4, 6]
+        assert bucket_ladder(1) == [1]
+        assert bucket_ladder(8, minimum=4) == [4, 8]
+
+    def test_bucket_ladder_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            bucket_ladder(0)
+
+    def test_pad_to_bucket(self):
+        sizes = bucket_ladder(8)
+        assert pad_to_bucket(1, sizes) == 1
+        assert pad_to_bucket(3, sizes) == 4
+        assert pad_to_bucket(8, sizes) == 8
+        assert pad_to_bucket(9, sizes) == 9  # beyond the ladder
+        assert pad_to_bucket(3, None) == 3  # bucketing disabled
+
+    def test_dynamic_batcher_uses_shared_policy(self):
+        """The batcher's padding must BE the shared implementation —
+        a formed batch of 3 against a [1,2,4,8] ladder pads to 4."""
+        seen = []
+
+        def compute(tree, n):
+            seen.append((np.asarray(tree).shape[0], n))
+            return np.asarray(tree)
+
+        with DynamicBatcher(compute, maximum_batch_size=8,
+                            timeout_ms=1.0,
+                            pad_to_sizes=bucket_ladder(8)) as batcher:
+            futures = [batcher.compute_async(np.float32(i))
+                       for i in range(3)]
+            for future in futures:
+                future.result(timeout=10)
+        padded_sizes = {shape for shape, _ in seen}
+        valid = {n for _, n in seen}
+        assert padded_sizes <= {1, 2, 4, 8}
+        assert sum(valid) == 3
+
+
+# ---------------------------------------------------------------------------
+# Per-env trajectory packing
+# ---------------------------------------------------------------------------
+
+
+def _row(tree, t, e):
+    """Entry (t, env e) of a [T+1, B, ...] tree as a width-1 lane row."""
+    return map_structure(
+        lambda x: None if x is None else np.asarray(x)[t, e:e + 1], tree)
+
+
+def _replay_order(num_steps, num_envs, rng):
+    """Per-step scrambled env visitation: every env appears once per
+    step, order varies — the arrival interleaving continuous batching
+    produces."""
+    orders = []
+    for _ in range(num_steps):
+        order = list(range(num_envs))
+        rng.shuffle(order)
+        orders.append(order)
+    return orders
+
+
+class TestTrajectoryPacker:
+    def test_bit_identical_to_vector_actor(self, agent_and_params):
+        """Feed a packer the per-step rows of a real VectorActor run
+        (same seeds), one env at a time in SCRAMBLED arrival order, and
+        require bit-identical [T+1, B] trajectories — overlap entry,
+        boundary agent_state, every leaf."""
+        agent, params = agent_and_params
+        envs = make_envs()
+        try:
+            actor = VectorActor(agent, envs, T, seed=7)
+            reference = [tree_as_numpy(actor.run_unroll(params))
+                         for _ in range(3)]
+        finally:
+            envs.close()
+
+        packer = TrajectoryPacker([1] * B, T)
+        first = reference[0]
+        for e in range(B):
+            packer.bootstrap(
+                e, _row(first.env_outputs, 0, e),
+                _row(first.agent_outputs, 0, e),
+                np.asarray(first.agent_state.c)[e:e + 1],
+                np.asarray(first.agent_state.h)[e:e + 1])
+
+        rng = np.random.RandomState(0)
+        popped = []
+        for k, traj in enumerate(reference):
+            next_state = (reference[k + 1].agent_state
+                          if k + 1 < len(reference)
+                          else AgentState(
+                              c=np.zeros((B, agent.core_size),
+                                         np.float32),
+                              h=np.zeros((B, agent.core_size),
+                                         np.float32)))
+            for t, order in enumerate(_replay_order(T, B, rng),
+                                      start=1):
+                for e in order:
+                    need_state = packer.stage_inference(
+                        e, _row(traj.agent_outputs, t, e))
+                    assert need_state == (t == T)
+                    if need_state:
+                        packer.stage_state(
+                            e,
+                            np.asarray(next_state.c)[e:e + 1],
+                            np.asarray(next_state.h)[e:e + 1])
+                    completed = packer.add_env(
+                        e, _row(traj.env_outputs, t, e))
+                    assert completed == (t == T)
+            assert packer.ready()
+            popped.append(packer.pop())
+            assert not packer.ready()
+
+        for k, (birth, state, env_outputs, agent_outputs) in enumerate(
+                popped):
+            assert birth > 0
+            assert_trees_equal(env_outputs, reference[k].env_outputs,
+                               msg=f"env_outputs diverge at unroll {k}")
+            assert_trees_equal(agent_outputs,
+                               reference[k].agent_outputs,
+                               msg=f"agent_outputs diverge at unroll {k}")
+            np.testing.assert_array_equal(
+                state.c, np.asarray(reference[k].agent_state.c))
+            np.testing.assert_array_equal(
+                state.h, np.asarray(reference[k].agent_state.h))
+
+    def _synthetic_step(self, packer, lane, value):
+        agent_row = np.full((1, 2), value, np.float32)
+        need = packer.stage_inference(lane, agent_row)
+        if need:
+            packer.stage_state(lane, np.zeros((1, 3), np.float32),
+                               np.zeros((1, 3), np.float32))
+        return packer.add_env(lane, np.full((1,), value, np.float32))
+
+    def test_straggler_buffers_without_stalling_siblings(self):
+        """Lane 0 runs two full unrolls ahead; its output parks in the
+        completed buffer (no error, no emission) until lane 1 catches
+        up — then batches pop oldest-first."""
+        packer = TrajectoryPacker([1, 1], unroll_length=2)
+        for lane in (0, 1):
+            packer.bootstrap(lane, np.full((1,), -1.0, np.float32),
+                             np.full((1, 2), -1.0, np.float32),
+                             np.zeros((1, 3), np.float32),
+                             np.zeros((1, 3), np.float32))
+        value = 0.0
+        for _ in range(2):  # two full unrolls on lane 0 only
+            for _ in range(2):
+                value += 1.0
+                self._synthetic_step(packer, 0, value)
+        assert packer.completed_depth(0) == 2
+        assert packer.completed_depth(1) == 0
+        assert not packer.ready()
+        for step in range(2):  # lane 1 catches up one unroll
+            self._synthetic_step(packer, 1, 100.0 + step)
+        assert packer.ready()
+        _, _, env_outputs, _ = packer.pop()
+        # Oldest lane-0 unroll paired with lane 1's: [T+1, 2] values.
+        np.testing.assert_array_equal(
+            env_outputs[:, 0], np.asarray([-1.0, 1.0, 2.0], np.float32))
+        np.testing.assert_array_equal(
+            env_outputs[:, 1],
+            np.asarray([-1.0, 100.0, 101.0], np.float32))
+        assert packer.completed_depth(0) == 1
+        assert not packer.ready()
+
+    def test_protocol_violations_raise(self):
+        packer = TrajectoryPacker([1], unroll_length=2)
+        packer.bootstrap(0, np.zeros((1,)), np.zeros((1, 2)),
+                         np.zeros((1, 3)), np.zeros((1, 3)))
+        with pytest.raises(RuntimeError, match="no staged inference"):
+            packer.add_env(0, np.zeros((1,)))
+        packer.stage_inference(0, np.zeros((1, 2)))
+        with pytest.raises(RuntimeError, match="second inference"):
+            packer.stage_inference(0, np.zeros((1, 2)))
+
+    def test_reset_drops_partials_and_buffered_unrolls(self):
+        packer = TrajectoryPacker([1, 1], unroll_length=2)
+        for lane in (0, 1):
+            packer.bootstrap(lane, np.zeros((1,)), np.zeros((1, 2)),
+                             np.zeros((1, 3)), np.zeros((1, 3)))
+        self._synthetic_step(packer, 0, 1.0)
+        packer.reset()
+        assert packer.completed_depth(0) == 0
+        assert packer.entry_count(0) == 0
+        # A fresh bootstrap is required (and sufficient) after reset.
+        packer.bootstrap(0, np.zeros((1,)), np.zeros((1, 2)),
+                         np.zeros((1, 3)), np.zeros((1, 3)))
+        assert packer.entry_count(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# MultiEnv per-worker async protocol
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerAPI:
+    def test_worker_slices_cover_the_batch(self):
+        envs = make_envs(n=5, workers=2)
+        try:
+            slices = envs.worker_slices()
+            assert envs.num_workers == 2
+            assert [s.start for s in slices] == [0, 3]
+            assert [s.stop for s in slices] == [3, 5]
+        finally:
+            envs.close()
+
+    def test_per_worker_steps_match_lockstep(self):
+        """The same seeds stepped per-worker must produce exactly the
+        lockstep path's outputs, slice by slice."""
+        lockstep = make_envs(seed_base=11)
+        perworker = make_envs(seed_base=11)
+        try:
+            ref = lockstep.initial()
+            outs = [perworker.worker_initial(w)
+                    for w in range(perworker.num_workers)]
+            for w, sl in enumerate(perworker.worker_slices()):
+                np.testing.assert_array_equal(
+                    outs[w].observation.frame,
+                    ref.observation.frame[sl])
+            actions = np.zeros((B,), np.int32)
+            for step in range(3):
+                lockstep.step_send(actions)
+                ref = lockstep.step_recv()
+                for w, sl in enumerate(perworker.worker_slices()):
+                    perworker.worker_send(w, actions[sl])
+                for w, sl in enumerate(perworker.worker_slices()):
+                    out = perworker.worker_recv(w)
+                    np.testing.assert_array_equal(
+                        out.observation.frame, ref.observation.frame[sl],
+                        err_msg=f"step {step} worker {w}")
+                    np.testing.assert_array_equal(out.reward,
+                                                  ref.reward[sl])
+                    np.testing.assert_array_equal(out.done,
+                                                  ref.done[sl])
+        finally:
+            lockstep.close()
+            perworker.close()
+
+    def test_dead_worker_respawns_on_per_worker_path(self):
+        envs = make_envs()
+        try:
+            for w in range(envs.num_workers):
+                envs.worker_initial(w)
+            envs._procs[0].kill()
+            envs._procs[0].join(timeout=5)
+            envs.worker_send(0, np.zeros((2,), np.int32))
+            out = envs.worker_recv(0)
+            # The respawned slice restarts with initial outputs:
+            # done=True marks the boundary, no episode stats recorded.
+            assert out.done.all()
+            np.testing.assert_array_equal(
+                out.info.episode_step, np.zeros((2,), np.int32))
+        finally:
+            envs.close()
+
+
+# ---------------------------------------------------------------------------
+# The live service
+# ---------------------------------------------------------------------------
+
+
+def _make_service(agent, groups=2, max_batch=0, **kwargs):
+    env_groups = [make_envs(seed_base=100 * g) for g in range(groups)]
+    return ActorService(agent, env_groups, T, level_name="fake_small",
+                        seed=3, max_batch=max_batch, **kwargs)
+
+
+class TestActorService:
+    def test_emits_learner_shaped_trajectories(self, agent_and_params):
+        agent, params = agent_and_params
+        service = _make_service(agent)
+        service.set_params(params)
+        service.start()
+        try:
+            for _ in range(3):
+                out = service.get_trajectory(timeout=120)
+                assert out.env_outputs.observation.frame.shape == (
+                    T + 1, B, 16, 16, 3)
+                assert out.agent_outputs.policy_logits.shape == (
+                    T + 1, B, NUM_ACTIONS)
+                assert out.agent_state.c.shape == (B, agent.core_size)
+                assert out.env_outputs.done.dtype == bool
+                assert out.agent_outputs.action.dtype == np.int32
+        finally:
+            service.stop()
+
+    def test_rejects_max_batch_below_widest_slice(self, agent_and_params):
+        agent, _ = agent_and_params
+        with pytest.raises(ValueError, match="widest worker slice"):
+            _make_service(agent, groups=1, max_batch=1)
+
+    def test_idle_worker_death_rebootstraps_lane_only(self,
+                                                      agent_and_params):
+        """A reply landing with NO inference staged (the worker died
+        idle — request parked in the ring — and worker_recv respawned
+        it) must recover at lane granularity: stale request invalidated
+        via the lane generation, lane re-bootstrapped, siblings and the
+        group restart budget untouched."""
+        agent, params = agent_and_params
+        service = _make_service(agent, groups=1)
+        service.set_params(params)
+        try:
+            group = service._groups[0]
+            for w in range(group.envs.num_workers):
+                service._bootstrap_lane(0, w, group.envs.worker_initial(w))
+            gen_before = group.lane_gen[0]
+            sibling_gen = group.lane_gen[1]
+            ring_before = len(service._ring)
+            assert not group.packer.has_staged(0)
+            out = group.envs.worker_initial(0)  # the respawned reply
+            service._handle_reply(0, 0, out)
+            assert group.lane_gen[0] == gen_before + 1
+            assert group.lane_gen[1] == sibling_gen
+            assert group.packer.entry_count(0) == 1  # fresh entry 0
+            assert group.packer.entry_count(1) == 1  # sibling untouched
+            assert len(service._ring) == ring_before + 1
+            # The stale parked request no longer matches the lane gen,
+            # so the inference thread will discard instead of dispatch.
+            stale = service._ring[0]
+            assert (stale.worker, stale.lane_gen) == (0, gen_before)
+            assert stale.lane_gen != group.lane_gen[0]
+        finally:
+            service.stop()
+
+    def test_worker_kill_chaos_respawns_midunroll(self, agent_and_params):
+        """A worker SIGKILLed mid-unroll: the per-worker respawn
+        substitutes initial outputs (done=True boundary), the packer
+        keeps its layout, and trajectories keep flowing."""
+        from scalable_agent_tpu.obs import get_registry
+        from scalable_agent_tpu.runtime import configure_faults
+
+        agent, params = agent_and_params
+        respawns = get_registry().counter("env/worker_respawns_total")
+        before = respawns.value
+        configure_faults("worker_kill@2")
+        try:
+            service = _make_service(agent, groups=1)
+            service.set_params(params)
+            service.start()
+            try:
+                for _ in range(4):
+                    out = service.get_trajectory(timeout=120)
+                    assert out.env_outputs.observation.frame.shape == (
+                        T + 1, B, 16, 16, 3)
+            finally:
+                service.stop()
+        finally:
+            configure_faults("")
+        assert respawns.value >= before + 1
+
+    def test_service_stall_chaos_trips_watchdog(self, agent_and_params,
+                                                monkeypatch):
+        """ISSUE 10 satellite: a wedged inference thread must go STALE
+        on the watchdog (forensics instead of silent learner
+        starvation) — and the run must recover once the stall ends."""
+        from scalable_agent_tpu.obs import configure_watchdog, get_registry
+        from scalable_agent_tpu.obs.registry import MetricsRegistry
+        from scalable_agent_tpu.runtime import configure_faults
+
+        monkeypatch.setenv("SCALABLE_AGENT_SERVICE_STALL_S", "1.5")
+        # PRIVATE registry for the watchdog: its stalls counter must not
+        # leak into later tests' prom snapshots (test_obs_smoke asserts
+        # a healthy run reads watchdog/stalls_total 0.0 off the global).
+        registry = MetricsRegistry()
+        stalls = registry.counter("watchdog/stalls_total")
+        injected = get_registry().counter("faults/injected_total")
+        stalls_before = stalls.value
+        injected_before = injected.value
+        configure_faults("service_stall@2")
+        configure_watchdog(0.3, registry=registry)
+        try:
+            service = _make_service(agent_and_params[0], groups=1)
+            service.set_params(agent_and_params[1])
+            service.start()
+            try:
+                out = service.get_trajectory(timeout=180)
+                assert out.env_outputs.observation.frame.shape[0] == T + 1
+                deadline = time.monotonic() + 30
+                while (stalls.value <= stalls_before
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+            finally:
+                service.stop()
+        finally:
+            configure_watchdog(None)
+            configure_faults("")
+        assert injected.value >= injected_before + 1
+        assert stalls.value >= stalls_before + 1
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 driver smoke (ISSUE 10 acceptance): --actor=service end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_driver_smoke_actor_service_ledger_complete(tmp_path,
+                                                    monkeypatch,
+                                                    capsys):
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.driver import train as run_train
+    from scalable_agent_tpu.obs import get_registry, report
+    from scalable_agent_tpu.obs.ledger import SEGMENTS
+
+    monkeypatch.setenv("SCALABLE_AGENT_LEDGER_MFU_PEAK", "1e12")
+    config = Config(
+        mode="train",
+        logdir=str(tmp_path / "run"),
+        level_name="fake_small",
+        num_actors=4,
+        batch_size=2,
+        unroll_length=4,
+        num_action_repeats=1,
+        total_environment_frames=32,  # 4 updates of 8 frames
+        height=16,
+        width=16,
+        num_env_workers_per_group=2,
+        compute_dtype="float32",
+        checkpoint_interval_s=1e9,
+        log_interval_s=0.0,
+        seed=5,
+        actor="service",
+    )
+
+    def _counters():
+        snap = get_registry().snapshot()
+        return {key: snap.get(f"ledger/trajectories_{key}_total", 0.0)
+                for key in ("opened", "retired", "discarded",
+                            "abandoned")}
+
+    before = _counters()
+    metrics = run_train(config)
+    assert metrics["env_frames"] == 32
+    delta = {key: value - before[key]
+             for key, value in _counters().items()}
+
+    # Complete ledger artifact: zero open records, conservation, every
+    # hand-off stage crossed.
+    paths = glob.glob(os.path.join(config.logdir, "ledger.p0.json"))
+    assert len(paths) == 1, paths
+    artifact = json.load(open(paths[0]))
+    assert artifact["open_records"] == []
+    assert delta["retired"] >= 4
+    assert delta["opened"] == (delta["retired"] + delta["discarded"]
+                               + delta["abandoned"])
+    stages_seen = {e["stage"] for e in artifact["ring_tail"]}
+    for stage in ("birth", "unroll_done", "queue_put", "queue_get",
+                  "put_done", "dispatch", "retire"):
+        assert stage in stages_seen, stage
+
+    # The new service stages publish through the registry/prom plane.
+    text = open(os.path.join(config.logdir, "metrics.prom")).read()
+    assert "impala_ledger_rho_service_batch" in text
+    assert "impala_ledger_rho_service_wait" in text
+    assert "impala_service_batch_s_count" in text
+    values = {}
+    for line in text.splitlines():
+        if line.startswith("impala_") and " " in line \
+                and not line.startswith("#"):
+            key, _, value = line.rpartition(" ")
+            try:
+                values[key] = float(value)
+            except ValueError:
+                pass
+    assert values["impala_ledger_open_records"] == 0.0
+    assert values["impala_service_batches_total"] > 0.0
+    shares = {name: values[f"impala_ledger_latency_share_{name}"]
+              for name, _, _ in SEGMENTS}
+    assert sum(shares.values()) == pytest.approx(1.0, abs=1e-6)
+
+    # The report CLI renders the service rows and a dominant stage.
+    assert report.main([config.logdir]) == 0
+    out = capsys.readouterr().out
+    assert "service_batch" in out
+    assert "dominant stage:" in out
+    assert "top recommendation:" in out
+
+
+def test_ingraph_rejects_actor_service(tmp_path):
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.driver import train as run_train
+
+    config = Config(mode="train", logdir=str(tmp_path / "run"),
+                    level_name="fake_small", train_backend="ingraph",
+                    actor="service")
+    with pytest.raises(ValueError, match="no host actor pipeline"):
+        run_train(config)

@@ -87,6 +87,18 @@ class TestEnvProcess:
             out = proc.step_recv()
             assert out.observation.frame.shape == (16, 16, 3)
 
+    def test_step_ready_probe(self):
+        """The async completion probe: False with nothing outstanding,
+        True once the dispatched step's reply is readable, and False
+        again after step_recv consumed it."""
+        with EnvProcess(make_small_stream, frame_spec=FRAME_SPEC) as proc:
+            proc.initial()
+            assert proc.step_ready() is False  # nothing dispatched
+            proc.step_send(0)
+            assert proc.step_ready(timeout=10.0) is True
+            proc.step_recv()
+            assert proc.step_ready() is False
+
     def test_close_idempotent(self):
         proc = EnvProcess(make_small_stream).start()
         proc.initial()
