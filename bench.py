@@ -103,10 +103,17 @@ def _compile_update(learner, state, traj, diag):
     the measurement loop (lower().compile() artifacts don't land in jit's
     dispatch cache, so calling learner.update afterwards would pay the
     multi-minute production-shape compile a second time).  Also records
-    XLA cost-analysis FLOPs.  Falls back to the jitted path on error."""
+    XLA cost-analysis FLOPs.  Falls back to the jitted path on error.
+
+    The raw jitted signature now threads the device-telemetry pytree
+    (donated, obs/device_telemetry.py); the returned callable keeps the
+    bench's historical ``update(state, traj) -> (state, metrics)``
+    surface by rebinding the telemetry buffers internally — so every
+    timed window measures the update WITH its telemetry, exactly what
+    production pays."""
     t0 = time.perf_counter()
     try:
-        compiled = learner._update.lower(state, traj).compile()
+        compiled = learner.lower_update(state, traj).compile()
     except Exception:
         diag["errors"].append(
             "AOT compile failed, using jit path: "
@@ -121,7 +128,15 @@ def _compile_update(learner, state, traj, diag):
     except Exception:
         diag["errors"].append(
             "cost_analysis failed: " + traceback.format_exc(limit=1))
-    return compiled
+    def update(state, traj):
+        state, devtel, metrics = compiled(
+            state, traj, learner.device_telemetry)
+        # Hand the rebound buffers back so the learner's fetch path
+        # keeps reading live telemetry, not the donated husk.
+        learner.adopt_device_telemetry(devtel)
+        return state, metrics
+
+    return update
 
 
 def _fetch_scalar(x) -> float:
@@ -1183,6 +1198,79 @@ def bench_ledger(diag):
             per_update_s / sec_per_update, 6)
 
 
+def bench_devtel(diag):
+    """Device-telemetry overhead (ISSUE 12 acceptance: <1% of the
+    update stage).  Three unit costs at their real cadences:
+
+    - ``devtel_accumulate_us`` — the in-graph cost of the learner's
+      REAL instrument set (2 counter incs + 1 gauge set + 1 bucketed
+      grad-norm observe, runtime/learner.py learner_telemetry_spec),
+      timed with the pipelined-scan harness so dispatch is paid once.
+      This is the only cost paid PER UPDATE.
+    - ``devtel_fetch_us`` — one host materialization of the full
+      telemetry pytree (the log-interval device→host sync).
+    - ``devtel_publish_us`` — folding a fetched snapshot into a
+      registry (TelemetryPublisher.publish, pure host work).
+
+    ``devtel_overhead_frac_on_update`` charges accumulate to every
+    update and fetch+publish at their real TIME cadence
+    (``DEVTEL_LOG_INTERVAL_S``, the driver's default log interval) —
+    production pays them once per log interval, and on the remote-
+    tunnel TPU rig one fetch costs a full link RTT (~66 ms, BENCH_r04),
+    which charged per-update would dwarf any 5 ms update stage without
+    one byte of per-update cost existing.  The un-amortized reading
+    stays in ``devtel_worst_case_frac_on_update`` for the artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.obs.device_telemetry import TelemetryPublisher
+    from scalable_agent_tpu.runtime.learner import learner_telemetry_spec
+
+    spec = learner_telemetry_spec()
+    tel = spec.init()
+
+    def accumulate(tel, loss, grad_norm, skipped):
+        tel = spec.inc(tel, "updates")
+        tel = spec.set(tel, "loss", loss)
+        tel = spec.observe(tel, "grad_norm", grad_norm)
+        tel = spec.inc(tel, "skipped", skipped)
+        return tel
+
+    args = (tel, jnp.float32(1.5), jnp.float32(3.0), jnp.float32(0.0))
+    _record_timed(diag, "devtel_accumulate_us", accumulate, args,
+                  iters=200)
+
+    # Fetch: the one device->host sync, at log cadence.  Warm once so
+    # the first-call dispatch doesn't pollute the mean.
+    filled = jax.jit(accumulate)(*args)
+    spec.fetch(filled)
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fetched = spec.fetch(filled)
+    diag["devtel_fetch_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    publisher = TelemetryPublisher(spec, registry=MetricsRegistry())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        publisher.publish(fetched)
+    diag["devtel_publish_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    sec_per_update = diag.get("sec_per_update")
+    if sec_per_update:
+        log_cadence_us = (diag["devtel_fetch_us"]
+                          + diag["devtel_publish_us"])
+        diag["devtel_overhead_frac_on_update"] = round(
+            diag["devtel_accumulate_us"] / 1e6 / sec_per_update
+            + log_cadence_us / 1e6 / DEVTEL_LOG_INTERVAL_S, 6)
+        diag["devtel_worst_case_frac_on_update"] = round(
+            (diag["devtel_accumulate_us"] + log_cadence_us)
+            / 1e6 / sec_per_update, 6)
+
+
 def bench_transport(diag, budget_s=150.0):
     """Trajectory-transport stage (ISSUE 3): packed single-copy H2D vs
     the per-leaf ``device_put`` storm at the production trajectory
@@ -1941,6 +2029,128 @@ def elastic_regression_guard(diag):
             f"likely regressed")
 
 
+# Device telemetry's budget on the update stage (ISSUE 12 acceptance):
+# in-graph accumulate + amortized fetch/publish must stay under 1% —
+# half the general obs envelope, because this layer rides INSIDE the
+# jitted update.
+DEVTEL_BUDGET_FRAC = 0.01
+
+# The fetch+publish pair runs once per log interval (a TIME cadence —
+# Config.log_interval_s, default 10 s), so its per-update share is
+# (fetch+publish)/log_interval regardless of update speed.  Charging
+# it to every update instead would fail the TPU guard on the tunnel's
+# ~66 ms link RTT alone, with zero per-update cost existing.
+DEVTEL_LOG_INTERVAL_S = 10.0
+
+# The devtel keys bench_devtel publishes (obs-guard-style missing-key
+# protection: a key the previous round had must not silently vanish).
+DEVTEL_GUARD_KEYS = (
+    "devtel_overhead_frac_on_update",
+    "devtel_worst_case_frac_on_update",
+    "devtel_accumulate_us",
+    "devtel_fetch_us",
+    "devtel_publish_us",
+)
+
+
+def devtel_regression_guard(diag, bench_dir=None):
+    """ISSUE 12 acceptance: fail the bench when device telemetry
+    (accumulate per update + fetch/publish amortized at the
+    ``DEVTEL_LOG_INTERVAL_S`` time cadence) exceeds 1% of the update
+    stage — binding on TPU, advisory on the CPU fallback where the
+    tiny sec_per_update makes the ratio jitter-bound (the ledger/fleet
+    guard discipline).  Obs-guard-style: a devtel key the previous
+    round's artifact published that this round didn't is always an
+    error."""
+    frac = diag.get("devtel_overhead_frac_on_update")
+    if frac is not None and frac > DEVTEL_BUDGET_FRAC:
+        msg = (
+            f"DEVTEL: device-telemetry overhead {frac:.3%} of the "
+            f"update stage exceeds the {DEVTEL_BUDGET_FRAC:.0%} budget "
+            f"(accumulate {diag.get('devtel_accumulate_us')}us, fetch "
+            f"{diag.get('devtel_fetch_us')}us, publish "
+            f"{diag.get('devtel_publish_us')}us)")
+        if diag.get("platform") == "cpu":
+            diag.setdefault("warnings", []).append(
+                msg + " — CPU fallback: advisory, the tiny "
+                "sec_per_update makes the ratio jitter-bound")
+        else:
+            diag["errors"].append(msg)
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in DEVTEL_GUARD_KEYS:
+        if prev.get(key) and diag.get(key) is None:
+            diag["errors"].append(
+                f"DEVTEL REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
+
+
+# Per-kernel tolerances for the kernel guard: a named kernel running
+# at over 2x its previous time, or under half its previous MFU, is a
+# code regression, not window weather (on-chip kernel timings swing
+# far less than 2x between windows — the regression_guard rationale).
+KERNEL_GUARD_TOL_US = 2.0
+KERNEL_GUARD_TOL_MFU = 0.5
+
+_KERNEL_KEY_RE = None  # compiled lazily (re import stays local)
+
+
+def kernel_regression_guard(diag, bench_dir=None):
+    """ISSUE 12: any NAMED kernel regressing vs the newest committed
+    BENCH artifact fails the round.  Every ``kernel_<name>_us`` /
+    ``kernel_<name>_mfu`` key the previous round published is checked:
+    missing now -> always an error (the guard must not silently disarm
+    under a key rename); slower than ``KERNEL_GUARD_TOL_US``x or below
+    ``KERNEL_GUARD_TOL_MFU``x MFU -> error on TPU, advisory on the CPU
+    fallback (kernel micro-timings there measure host scheduling)."""
+    import re
+
+    global _KERNEL_KEY_RE
+    if _KERNEL_KEY_RE is None:
+        _KERNEL_KEY_RE = re.compile(
+            r"^kernel_(?P<name>.+)_(?P<kind>us|mfu)$")
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    hard = diag.get("platform") == "tpu"
+
+    def flag(message):
+        if hard:
+            diag["errors"].append(message)
+        else:
+            diag.setdefault("warnings", []).append(
+                message + " — CPU fallback: advisory")
+
+    compared = []
+    for key in sorted(prev):
+        match = _KERNEL_KEY_RE.match(key)
+        if not match:
+            continue
+        old = prev.get(key)
+        if not isinstance(old, (int, float)) or not old:
+            continue
+        cur = diag.get(key)
+        if cur is None:
+            diag["errors"].append(
+                f"KERNEL REGRESSION: {key} missing this round "
+                f"(previous round: {old}, {ref_name})")
+            continue
+        compared.append(key)
+        if match.group("kind") == "us" and cur > old * KERNEL_GUARD_TOL_US:
+            flag(f"KERNEL REGRESSION: {key} {cur}us is "
+                 f"{cur / old:.1f}x the previous round's {old}us "
+                 f"({ref_name})")
+        elif (match.group("kind") == "mfu"
+              and cur < old * KERNEL_GUARD_TOL_MFU):
+            flag(f"KERNEL REGRESSION: {key} mfu {cur} fell below "
+                 f"{KERNEL_GUARD_TOL_MFU:.0%} of the previous round's "
+                 f"{old} ({ref_name})")
+    if compared:
+        diag["kernel_regression_keys"] = len(compared)
+        diag["kernel_regression_reference"] = ref_name
+
+
 def transport_regression_guard(diag, bench_dir=None):
     """ISSUE 3 satellite: the packed transport must stay strictly
     better than the per-leaf path, and the in-flight window must keep
@@ -2359,6 +2569,12 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_ledger failed: " + traceback.format_exc(limit=2))
+    diag["stage"] = "bench_devtel"
+    try:
+        bench_devtel(diag)
+    except Exception:
+        diag["errors"].append(
+            "bench_devtel failed: " + traceback.format_exc(limit=2))
     diag["stage"] = "bench_transport"
     try:
         bench_transport(
@@ -2423,6 +2639,20 @@ def main():
     except Exception:
         diag["errors"].append(
             "ledger regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "devtel_regression_guard"
+    try:
+        devtel_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "devtel regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "kernel_regression_guard"
+    try:
+        kernel_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "kernel regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "transport_regression_guard"
     try:

@@ -472,6 +472,72 @@ def _host_scalar(x) -> float:
     return float(np.asarray(x))
 
 
+def _resolve_roofline_peak() -> Optional[float]:
+    """Per-chip roofline peak (obs/ledger.py PEAK_FLOPS), overridable
+    via SCALABLE_AGENT_LEDGER_MFU_PEAK so the full MFU/kernel path is
+    exercisable on the CPU rig.  None when the chip is unknown and no
+    override is set."""
+    from scalable_agent_tpu.obs.ledger import peak_flops_per_chip
+
+    peak = peak_flops_per_chip(jax.local_devices()[0].device_kind)
+    override = os.environ.get("SCALABLE_AGENT_LEDGER_MFU_PEAK")
+    if override:
+        try:
+            peak = float(override)
+        except ValueError:
+            pass
+    return peak
+
+
+def _harvest_kernel_ledger(config: Config, lower_fn,
+                           executions: int) -> None:
+    """Join the finished ``--profile_dir`` trace window with the
+    compiled update's HLO + cost analysis into the per-kernel roofline
+    ledger: ``<logdir>/kernels.json`` plus ``kernel/*`` registry gauges
+    (obs/kernels.py; the worst-kernel verdict also feeds the stall
+    line).  Pays one AOT compile of the update — acceptable inside an
+    explicit profiling run, and the only sanctioned way to read the
+    optimized HLO whose instruction names the trace events carry.
+    Never raises: the ledger is forensics, not the training path."""
+    from scalable_agent_tpu.obs import kernels as kernels_lib
+
+    try:
+        compiled = lower_fn().compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float((cost or {}).get("flops", 0.0))
+        hlo_text = compiled.as_text()
+    except Exception:
+        log.exception("kernel ledger: update compile/cost read failed")
+        return
+    try:
+        table = kernels_lib.harvest(
+            config.profile_dir, hlo_text, flops,
+            _resolve_roofline_peak(), config.logdir,
+            registry=get_registry(), executions=executions,
+            extra={"device_kind": jax.local_devices()[0].device_kind,
+                   "logdir": config.logdir})
+    except Exception:
+        log.exception("kernel ledger harvest failed")
+        return
+    if table is None:
+        log.warning("kernel ledger: no trace files under %s",
+                    config.profile_dir)
+        return
+    log.info(
+        "kernel ledger: %d kernels joined (%.0f%% of event time), "
+        "dominant %s (%.0f%% of kernel time), worst %s (mfu %s) — "
+        "%s/kernels.json",
+        len(table["kernels"]), 100 * table["matched_time_frac"],
+        table.get("dominant_kernel"),
+        100 * (table.get("dominant_time_share") or 0.0),
+        table.get("worst_kernel"),
+        (f"{table['worst_kernel_mfu']:.3f}"
+         if table.get("worst_kernel_mfu") is not None else "n/a"),
+        config.logdir)
+
+
 def _configure_live_mfu(ledger, lower_fn, num_devices: int):
     """Arm the ledger's live ``ledger/mfu`` gauge (obs/ledger.py).
 
@@ -484,15 +550,7 @@ def _configure_live_mfu(ledger, lower_fn, num_devices: int):
     gauge then stays at 0, and no test pays the lowering); the
     SCALABLE_AGENT_LEDGER_MFU_PEAK env var overrides the peak so the
     full path is exercisable anywhere."""
-    from scalable_agent_tpu.obs.ledger import peak_flops_per_chip
-
-    peak = peak_flops_per_chip(jax.local_devices()[0].device_kind)
-    override = os.environ.get("SCALABLE_AGENT_LEDGER_MFU_PEAK")
-    if override:
-        try:
-            peak = float(override)
-        except ValueError:
-            pass
+    peak = _resolve_roofline_peak()
     if not peak:
         return
     try:
@@ -734,7 +792,7 @@ def train(config: Config) -> Dict[str, float]:
         frames_per_trajectory=config.frames_per_update(),
         logdir=config.logdir,
         process_index=jax.process_index())
-    pool = prefetch_thread = writer = ckpt = None
+    pool = prefetch_thread = writer = ckpt = learner = None
     prefetch_stop = threading.Event()
     profiling = False
     completed = False
@@ -803,7 +861,7 @@ def train(config: Config) -> Dict[str, float]:
             batch=max(1, config.batch_size // jax.process_count()),
             t_plus_1=config.unroll_length + 1)
         _configure_live_mfu(
-            ledger, lambda: learner._update.lower(state, mfu_example),
+            ledger, lambda: learner.lower_update(state, mfu_example),
             max(1, learner.mesh.devices.size // jax.process_count()))
         del mfu_example
 
@@ -971,6 +1029,25 @@ def train(config: Config) -> Dict[str, float]:
                 profiling = False
                 log.info("profiler trace written to %s",
                          config.profile_dir)
+                # Per-kernel roofline ledger over the window just
+                # captured (obs/kernels.py): rebuild the zero example
+                # at the update's real shapes for the lowering — the
+                # state/trajectory in flight carry the same avals.
+                kernel_example = zero_trajectory(
+                    config, observation_spec, agent,
+                    batch=max(1,
+                              config.batch_size // jax.process_count()),
+                    t_plus_1=config.unroll_length + 1)
+                # The harvest re-pays the production-shape AOT compile
+                # (multi-minute on TPU) on this thread: disarm the
+                # learner heartbeat across it like every other healthy
+                # long pause — the next loop touch re-arms.
+                watchdog.suspend("learner")
+                _harvest_kernel_ledger(
+                    config,
+                    lambda: learner.lower_update(state, kernel_example),
+                    executions=config.profile_num_updates)
+                del kernel_example
 
             now = time.monotonic()
             if now - last_log >= config.log_interval_s:
@@ -1046,6 +1123,11 @@ def train(config: Config) -> Dict[str, float]:
                 timing_summary = timing.summary()
                 host_metrics.update(
                     {f"timing/{k}": v for k, v in timing_summary.items()})
+                # Device telemetry: the ONE fetch the on-device
+                # instruments ever cost (a few hundred bytes at log
+                # cadence), folded into the registry as devtel/* so it
+                # rides the writer/prom dumps below.
+                learner.publish_device_telemetry()
                 # Ledger derivation BEFORE stall attribution, so the
                 # verdict line carries this interval's dominant-stage
                 # share (rates/ρ/staleness/MFU land in the registry and
@@ -1189,6 +1271,16 @@ def train(config: Config) -> Dict[str, float]:
             get_ledger().finalize()
         except Exception:
             log.exception("ledger finalize failed")
+        # Final device-telemetry publish BEFORE the teardown's prom
+        # dump: a run (or run tail) shorter than log_interval_s never
+        # hit the interval gate, and the final metrics.prom would show
+        # devtel/* absent or frozen at the last fetch.  Guarded — on
+        # the exception path the device buffers may be donated husks.
+        if learner is not None:
+            try:
+                learner.publish_device_telemetry()
+            except Exception:
+                log.exception("final device-telemetry publish failed")
         if writer is not None:
             writer.close()
         if ckpt is not None:
@@ -1273,6 +1365,13 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     return Learner(agent, hp, mesh, config.frames_per_update(),
                    scan_impl=config.scan_impl,
                    transport=transport)
+
+
+# How many fused updates may be dispatched-but-unretired before the
+# in-graph loop forces one materialization to retire them: safely under
+# the ledger's 8192 open-record capacity, and high enough that the
+# log-interval fetch almost always fires first.
+_INGRAPH_PENDING_CAP = 2048
 
 
 def train_ingraph(config: Config) -> Dict[str, float]:
@@ -1372,11 +1471,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         recorder=get_flight_recorder(),
         epoch=config.fleet_epoch,
         logdir=config.logdir)
-    # Ledger in the fused backend: there is no host pipeline to stamp —
-    # each update opens a degenerate record (birth = dispatch, closed
-    # retired on materialization order), which keeps the update-cadence
-    # accounting, the retire counters, and the live MFU gauge alive
-    # with the same names as the host backend.
+    # Ledger in the fused backend: there is no host pipeline to stamp,
+    # but the records are no longer degenerate — each update opens a
+    # record at dispatch, and the whole in-flight stream retires at the
+    # NEXT log-interval metrics fetch (the loop's only real device
+    # sync), so birth→retire measures the true dispatch-to-
+    # materialization latency of the fused stream (the device segment
+    # = the in-flight window, matching the host backend's semantics)
+    # and the retire rate drives the live MFU gauge honestly.
     ledger = configure_ledger(
         registry=registry,
         frames_per_trajectory=config.frames_per_update(),
@@ -1386,6 +1488,8 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         ledger,
         lambda: trainer.train_step.lower(state, carry, np.int32(0)),
         learner.mesh.devices.size)
+    profiling = False
+    profile_stop_at = None
     if restored is not None:
         fleet.note_checkpoint(start_updates)
     watchdog = get_watchdog()
@@ -1397,7 +1501,19 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         # Context-managed writer: the JSONL handle can't leak when the
         # loop (or checkpointing) raises.
         with MetricsWriter(config.logdir, registry=registry) as writer:
+            # Updates dispatched but not yet known-materialized: their
+            # ledger records retire together at the next metrics fetch.
+            pending_tids: List[int] = []
             while frames < config.total_environment_frames:
+                if (config.profile_dir and not profiling
+                        and updates - start_updates
+                        == config.profile_start_update):
+                    # Same --profile_dir window as the host backend —
+                    # the capture the kernel ledger joins below.
+                    jax.profiler.start_trace(config.profile_dir)
+                    get_tracer().set_annotate(True)
+                    profiling = True
+                    profile_stop_at = updates + config.profile_num_updates
                 ledger_tid = ledger.open("ingraph",
                                          config.level_name)
                 with timing.time_avg("update"), \
@@ -1410,15 +1526,64 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     state, carry, metrics = trainer.train_step(
                         state, carry, np.int32(updates))
                 ledger.stamp(ledger_tid, "dispatch")
-                ledger.close(ledger_tid, retired=True)
+                pending_tids.append(ledger_tid)
+                # Bound the open-record stream: a fused run fast enough
+                # to dispatch thousands of updates inside one log
+                # interval would overflow the ledger's open-record
+                # table (8192) and trip its eviction/truncation path.
+                # One explicit materialization per _INGRAPH_PENDING_CAP
+                # updates retires the whole window honestly (the device
+                # stream is in-order) — in the common case the
+                # log-interval fetch below fires first and this never
+                # runs.
+                if len(pending_tids) >= _INGRAPH_PENDING_CAP:
+                    jax.block_until_ready(metrics["total_loss"])
+                    for tid in pending_tids:
+                        ledger.close(tid, retired=True)
+                    pending_tids.clear()
                 watchdog.touch("learner")
                 updates += 1
                 frames += frames_per_update
+                if profiling and updates >= profile_stop_at:
+                    jax.block_until_ready(metrics["total_loss"])
+                    # The sync above materialized every pending
+                    # dispatch; retire them NOW, before the harvest's
+                    # multi-minute AOT compile below would inflate
+                    # their birth→retire stamps (and the staleness
+                    # histogram) by compile time the updates never saw.
+                    for tid in pending_tids:
+                        ledger.close(tid, retired=True)
+                    pending_tids.clear()
+                    jax.profiler.stop_trace()
+                    get_tracer().set_annotate(False)
+                    profiling = False
+                    log.info("profiler trace written to %s",
+                             config.profile_dir)
+                    # Disarm the heartbeat across the harvest's AOT
+                    # compile (multi-minute on TPU) — the loop's touch
+                    # below re-arms.
+                    watchdog.suspend("learner")
+                    _harvest_kernel_ledger(
+                        config,
+                        lambda: trainer.train_step.lower(
+                            state, carry, np.int32(0)),
+                        executions=config.profile_num_updates)
                 now = time.monotonic()
                 if now - last_log >= config.log_interval_s:
-                    ledger.publish()
                     host_metrics = _finalize_ingraph_metrics(
                         metrics, config)
+                    # The fetch above materialized the newest update;
+                    # the device stream is in-order, so every pending
+                    # dispatch has retired by now.
+                    for tid in pending_tids:
+                        ledger.close(tid, retired=True)
+                    pending_tids.clear()
+                    # Device telemetry (env episodes + learner update
+                    # instruments riding the donated carry): the one
+                    # obs fetch, folded into the registry for the prom
+                    # dump below.
+                    trainer.publish_telemetry(carry)
+                    ledger.publish()
                     if nonfinite.observe(host_metrics):
                         state, updates, frames = _rollback_or_exit(
                             config, ckpt, learner, state, nonfinite)
@@ -1461,6 +1626,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             # Same shutdown-tail disarm as the host backend: the final
             # forced save must not trip (or be aborted by) the watchdog.
             watchdog.suspend("learner")
+            if pending_tids and metrics:
+                # Clean-exit drain: one final materialization retires
+                # every still-pending record (otherwise finalize()
+                # would sweep real retires as "abandoned").
+                _finalize_ingraph_metrics(metrics, config)
+                for tid in pending_tids:
+                    ledger.close(tid, retired=True)
+                pending_tids.clear()
             if ckpt.maybe_save(updates, state, force=True):
                 fleet.note_checkpoint(updates)
     finally:
@@ -1474,10 +1647,23 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             fleet.note_fatal_error(_exc)
         configure_watchdog(None)  # same teardown-tail disarm as train()
         configure_faults("")
+        if profiling:
+            jax.profiler.stop_trace()
         try:
             get_ledger().finalize()
         except Exception:
             log.exception("ledger finalize failed")
+        # Final telemetry publish BEFORE the teardown's prom dump — on
+        # BOTH exit paths: a run (or run tail) shorter than
+        # log_interval_s never hit the interval gate, and a crash's
+        # final metrics.prom would show devtel/* absent or frozen at
+        # the last fetch while host counters show the true totals.
+        # Guarded — an exception mid-train_step leaves ``carry``
+        # holding donated husks.
+        try:
+            trainer.publish_telemetry(carry)
+        except Exception:
+            log.exception("final device-telemetry publish failed")
         ckpt.close()
         _teardown_observability(config, obs_handles)
         configure_fleet(None)  # after obs: covers the whole tail

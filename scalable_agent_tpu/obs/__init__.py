@@ -24,6 +24,10 @@ from scalable_agent_tpu.obs.exporters import (
     PrometheusExporter,
     render_prometheus,
 )
+from scalable_agent_tpu.obs.device_telemetry import (
+    DeviceTelemetry,
+    TelemetryPublisher,
+)
 from scalable_agent_tpu.obs.flightrec import (
     FlightRecorder,
     configure_flight_recorder,
@@ -59,6 +63,7 @@ from scalable_agent_tpu.obs.watchdog import (
 __all__ = [
     "CATEGORIES",
     "Counter",
+    "DeviceTelemetry",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -68,6 +73,7 @@ __all__ = [
     "PipelineLedger",
     "PrometheusExporter",
     "StallAttributor",
+    "TelemetryPublisher",
     "Tracer",
     "Watchdog",
     "configure_flight_recorder",

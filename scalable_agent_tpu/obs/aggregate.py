@@ -223,7 +223,24 @@ def _fleet_fold(family: str, metric: str, kind: str,
     the fleet question is 'what does the most-pessimistic process
     see', and a process that noticed a dead peer must not be averaged
     away by ones that haven't polled yet."""
-    if kind == "counter" or metric.endswith(("_sum", "_count")):
+    if kind == "counter":
+        return "sum"
+    # Device telemetry (obs/device_telemetry.py): the counter series
+    # (devtel/..._total, bucket counters) are real Counters and SUM via
+    # the kind rule above; EVERY remaining devtel series (run-cumulative
+    # readings, last loss, exact histogram sum/count/mean gauges)
+    # answers "what does the most-telling process show" — MAX, checked
+    # BEFORE the generic _sum/_count summary rule so the fleet
+    # sum/count/mean triple stays one process's consistent reading
+    # instead of a sum-of-sums paired with a max-of-means.
+    # Kernel-ledger series (obs/kernels.py kernel/<name>/mfu, time
+    # shares, worst/dominant verdicts) likewise take the MAX: per-
+    # kernel MFU folds to the busiest process's reading and the worst-
+    # kernel label rides the per-kernel series NAME, so the max fold
+    # keeps the named verdict.
+    if metric.startswith(("impala_devtel_", "impala_kernel_")):
+        return "max"
+    if metric.endswith(("_sum", "_count")):
         return "sum"
     if "peers_alive" in metric:
         return "min"

@@ -1,0 +1,519 @@
+"""Per-kernel roofline ledger: profiler trace × cost analysis → kernels.json.
+
+BENCH_r04/r05 located the learner's worst kernel (``conv0_gradw`` at
+0.107 MFU for ~13 ms) by a human reading rooflines off a bench stage.
+The MFU 16%→40% push (ROADMAP item 3) needs that reading automated and
+attached to every profiled run: this module joins the two artifacts a
+run already produces —
+
+- a ``jax.profiler`` trace window (``--profile_dir``), whose device
+  events carry per-kernel names and durations (the event names are the
+  optimized HLO module's instruction names, identical on the CPU rig
+  and on TPU), and
+- the lowered update's compiled HLO text + ``cost_analysis()`` FLOPs
+  (the same numerator the live ``ledger/mfu`` gauge uses),
+
+into a per-kernel table: time, calls, FLOPs, bytes, arithmetic
+intensity, and roofline MFU against the shared ``PEAK_FLOPS`` table
+(obs/ledger.py — one denominator for the bench headline, the live
+gauge, and this ledger).
+
+Per-kernel FLOPs come from a mini HLO cost model (``parse_hlo_kernel_
+costs``): dots count ``2·prod(result)·K`` from the contracting dims,
+convolutions ``2·out_elems·kernel_elems/out_features`` from
+``dim_labels``, fusions sum their called computation, elementwise ops
+count one flop per result element.  The raw estimates are then
+NORMALIZED so the matched kernels' per-update FLOPs sum exactly to the
+XLA cost-analysis total — XLA's aggregate is authoritative (it is the
+MFU numerator), the HLO parse distributes it across kernels.  Both the
+raw estimate and the normalized attribution land in ``kernels.json``.
+
+Intentionally jax-free, like report/aggregate: everything here parses
+text the caller hands over (trace json, HLO text), so the report CLI
+can re-read ``kernels.json`` on a laptop and tests can feed synthetic
+modules.  The driver's entry point is ``harvest()`` (both backends
+call it right after ``jax.profiler.stop_trace()``).
+"""
+
+import glob
+import gzip
+import json
+import math
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "KERNELS_JSON_NAME",
+    "build_kernel_table",
+    "find_profiler_traces",
+    "harvest",
+    "hlo_module_name",
+    "last_dominant",
+    "last_worst",
+    "load_trace_kernel_events",
+    "parse_hlo_kernel_costs",
+    "publish_kernel_metrics",
+    "write_kernels_json",
+]
+
+_SCHEMA_VERSION = 1
+KERNELS_JSON_NAME = "kernels.json"
+
+# Kernels below this share of matched device time are excluded from the
+# "worst kernel" verdict: a 0.1%-of-time kernel at 0.01 MFU is noise,
+# not the roofline target.
+WORST_MIN_TIME_SHARE = 0.02
+
+# How many kernels get per-kernel registry gauges (the full table lives
+# in kernels.json; the registry carries the actionable head).
+PUBLISH_TOP_N = 8
+
+
+# -- HLO parsing -------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16, "s4": 1, "u4": 1, "f8e4m3fn": 1,
+    "f8e5m2": 1,
+}
+
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+[0-9a-z]*)\[(?P<dims>[0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<shape>\([^)]*\)|\S+)"
+    r"\s+(?P<op>[\w\-]+)\((?P<args>[^()]*)\)(?P<attrs>.*)$")
+_COMPUTATION_RE = re.compile(
+    r"^\s*(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*(?:\([^)]*\))?\s*->"
+    r".*\{\s*$")
+# The called-computation attr differs per op: fusion/call use
+# ``calls=``, while uses ``body=`` (one trip's worth — the static
+# estimate; trip counts aren't in the HLO text), map uses
+# ``to_apply=``.  Conditional's ``branch_computations={...}`` is a
+# list and is left to the elementwise fallback.
+_CALLS_RE = re.compile(r"(?:calls|body|to_apply)=%?([\w.\-]+)")
+_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
+
+# Opcodes that move/reshape data without arithmetic.
+_ZERO_FLOP_OPS = frozenset((
+    "parameter", "constant", "bitcast", "bitcast-convert", "copy",
+    "copy-start", "copy-done", "reshape", "broadcast", "transpose",
+    "get-tuple-element", "tuple", "iota", "slice", "dynamic-slice",
+    "dynamic-update-slice", "concatenate", "pad", "reverse", "gather",
+    "scatter", "after-all", "partition-id", "replica-id", "rng-state",
+    "opt-barrier", "domain", "send", "send-done", "recv", "recv-done",
+))
+
+
+def _parse_shapes(text: str) -> List[Tuple[int, List[int]]]:
+    """Every ``dtype[d0,d1,...]`` in ``text`` -> (bytes_per_elem, dims).
+    Handles tuple results by simply yielding each component."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        dtype = m.group("dtype")
+        if dtype not in _DTYPE_BYTES:
+            continue
+        dims_text = m.group("dims")
+        dims = [int(d) for d in dims_text.split(",") if d] or [1]
+        out.append((_DTYPE_BYTES[dtype], dims))
+    return out
+
+
+def _elems(shapes: List[Tuple[int, List[int]]]) -> int:
+    return sum(math.prod(dims) for _, dims in shapes)
+
+
+def _bytes(shapes: List[Tuple[int, List[int]]]) -> int:
+    return sum(b * math.prod(dims) for b, dims in shapes)
+
+
+def _instruction_flops(op: str, result: List, operands: List,
+                       attrs: str, called_flops: Optional[float]) -> float:
+    """The mini cost model, per execution of one instruction."""
+    if op in _ZERO_FLOP_OPS:
+        return 0.0
+    out_elems = _elems(result)
+    if op == "dot":
+        m = _LHS_CONTRACT_RE.search(attrs)
+        if m and operands:
+            lhs_dims = operands[0][1]
+            k = math.prod(
+                lhs_dims[int(i)] for i in m.group(1).split(",")
+                if i and int(i) < len(lhs_dims)) or 1
+            return 2.0 * out_elems * k
+        return 2.0 * out_elems
+    if op == "convolution":
+        m = _DIM_LABELS_RE.search(attrs)
+        if m and len(operands) >= 2:
+            out_labels = m.group(3)
+            kernel_elems = math.prod(operands[1][1])
+            feature_axis = out_labels.find("f")
+            out_features = (result[0][1][feature_axis]
+                            if result and 0 <= feature_axis
+                            < len(result[0][1]) else 1)
+            return 2.0 * out_elems * kernel_elems / max(1, out_features)
+        return 2.0 * out_elems
+    if op in ("fusion", "call", "while", "map"):
+        # The kernel's arithmetic is its called computation's (for
+        # while: one trip of the body — the static estimate).
+        return called_flops if called_flops is not None else 0.0
+    if op in ("reduce", "reduce-window", "reduce-scatter", "all-reduce",
+              "select-and-scatter", "sort", "cumsum"):
+        return float(_elems(operands) or out_elems)
+    # Elementwise / transcendental / comparison / rng / custom-call
+    # fallback: one flop per result element — a floor, not a claim.
+    return float(out_elems)
+
+
+def parse_hlo_kernel_costs(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Optimized-HLO text -> per-instruction cost estimates.
+
+    Returns ``{instruction_name: {"flops_est", "bytes", "op"}}`` for
+    every instruction in every computation (while-loop bodies included
+    — their instructions are the kernels a scan's trace events name),
+    with fusion/call instructions summing their called computation's
+    flops and charging bytes at the fusion boundary (operands + result
+    — the memory the fused kernel actually touches)."""
+    # Pass 1: collect raw instructions per computation.
+    computations: Dict[str, List[dict]] = {}
+    current = None
+    for line in hlo_text.splitlines():
+        comp = _COMPUTATION_RE.match(line)
+        if comp:
+            current = comp.group("name")
+            computations[current] = []
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        if current is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        computations[current].append({
+            "name": m.group("name"),
+            "op": m.group("op"),
+            "result": _parse_shapes(m.group("shape")),
+            "operands": _parse_shapes(m.group("args")),
+            "attrs": m.group("attrs"),
+        })
+
+    # Pass 2: per-computation flops sums (for fusion/call resolution),
+    # resolved iteratively so nesting order in the text doesn't matter.
+    comp_flops: Dict[str, float] = {}
+
+    def _computation_flops(name: str, stack: Tuple[str, ...]) -> float:
+        if name in comp_flops:
+            return comp_flops[name]
+        if name in stack:  # recursive call structure: refuse the cycle
+            return 0.0
+        total = 0.0
+        for instr in computations.get(name, ()):
+            total += _resolve_flops(instr, stack + (name,))
+        comp_flops[name] = total
+        return total
+
+    def _resolve_flops(instr: dict, stack: Tuple[str, ...]) -> float:
+        called = None
+        if instr["op"] in ("fusion", "call", "while", "map"):
+            m = _CALLS_RE.search(instr["attrs"])
+            if m:
+                called = _computation_flops(m.group(1), stack)
+        return _instruction_flops(instr["op"], instr["result"],
+                                  instr["operands"], instr["attrs"],
+                                  called)
+
+    costs: Dict[str, Dict[str, float]] = {}
+    for comp_name, instrs in computations.items():
+        for instr in instrs:
+            costs[instr["name"]] = {
+                "flops_est": _resolve_flops(instr, (comp_name,)),
+                "bytes": float(_bytes(instr["operands"])
+                               + _bytes(instr["result"])),
+                "op": instr["op"],
+            }
+    return costs
+
+
+# -- trace ingestion ---------------------------------------------------------
+
+
+def find_profiler_traces(profile_dir: str) -> List[str]:
+    """The newest profiler session's ``*.trace.json(.gz)`` files under
+    ``<profile_dir>/plugins/profile/<timestamp>/`` (the layout
+    ``jax.profiler.start_trace`` writes)."""
+    sessions = sorted(glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*")))
+    if not sessions:
+        return []
+    newest = sessions[-1]
+    return sorted(glob.glob(os.path.join(newest, "*.trace.json.gz"))
+                  + glob.glob(os.path.join(newest, "*.trace.json")))
+
+
+_HLO_MODULE_RE = re.compile(r"^HloModule\s+([^\s,]+)")
+
+
+def hlo_module_name(hlo_text: str) -> Optional[str]:
+    """The module name off the compiled HLO's ``HloModule ...`` header
+    (what the profiler stamps as ``args.hlo_module`` on its kernel
+    events)."""
+    m = _HLO_MODULE_RE.match(hlo_text)
+    return m.group(1) if m else None
+
+
+def load_trace_kernel_events(path: str, module: Optional[str] = None
+                             ) -> Dict[str, Dict[str, float]]:
+    """One Chrome-trace file -> ``{event_name: {"time_us", "calls"}}``
+    aggregated over every complete ('X') event.
+
+    ``module`` scopes the read to one HLO module: XLA instruction
+    names are unique only PER MODULE, and other jitted programs run
+    concurrently during the window (the host backend's actor_step,
+    inference services), so an annotated event whose
+    ``args.hlo_module`` differs from ``module`` is dropped — its
+    ``fusion.1`` is not the update's ``fusion.1``.  Events without the
+    annotation pass through (the cost-table join downstream still
+    decides what is a kernel), so an exotic backend that doesn't stamp
+    modules degrades to the by-name join instead of an empty table."""
+    if path.endswith(".gz"):
+        raw = gzip.open(path, "rt").read()
+    else:
+        raw = open(path).read()
+    data = json.loads(raw)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    out: Dict[str, Dict[str, float]] = {}
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        name = event.get("name")
+        if not name:
+            continue
+        if module is not None:
+            event_module = (event.get("args") or {}).get("hlo_module")
+            if event_module is not None and event_module != module:
+                continue
+        entry = out.setdefault(name, {"time_us": 0.0, "calls": 0.0})
+        entry["time_us"] += float(event.get("dur", 0.0))
+        entry["calls"] += 1.0
+    return out
+
+
+# -- the join ----------------------------------------------------------------
+
+
+def build_kernel_table(events: Dict[str, Dict[str, float]],
+                       costs: Dict[str, Dict[str, float]],
+                       flops_total: float = 0.0,
+                       peak_flops: Optional[float] = None,
+                       executions: int = 1) -> dict:
+    """Join trace events with HLO costs by kernel name.
+
+    ``flops_total`` is the XLA cost-analysis FLOPs for ONE execution of
+    the profiled program (the ledger-MFU numerator); ``executions`` is
+    how many times it ran inside the trace window.  Per-kernel
+    ``flops`` (per execution) are the HLO estimates normalized so they
+    sum exactly to ``flops_total`` — XLA's aggregate stays
+    authoritative, the parse distributes it.  Rows sort by total time
+    descending."""
+    rows = []
+    matched_time = 0.0
+    est_total = 0.0
+    for name, event in events.items():
+        cost = costs.get(name)
+        if cost is None:
+            continue
+        matched_time += event["time_us"]
+        per_exec = event["calls"] / max(1, executions)
+        est_total += cost["flops_est"] * per_exec
+        rows.append({
+            "name": name,
+            "time_us": round(event["time_us"], 3),
+            "calls": int(event["calls"]),
+            "flops_est": cost["flops_est"] * per_exec,
+            "flops_est_per_call": cost["flops_est"],
+            "bytes": cost["bytes"],
+            "op": cost["op"],
+        })
+    scale = (flops_total / est_total
+             if flops_total > 0 and est_total > 0 else 1.0)
+    window_time_us = sum(e["time_us"] for e in events.values())
+    for row in rows:
+        row["flops"] = row["flops_est"] * scale
+        row["time_share"] = (row["time_us"] / matched_time
+                             if matched_time else 0.0)
+        # Intensity is a PER-CALL property (flops/byte of one kernel
+        # launch): a scan-body kernel called T times per execution has
+        # T-times the aggregate flops but the same per-call bytes, so
+        # using the aggregate would inflate it T-fold and misread
+        # memory-bound kernels as compute-bound.
+        row["intensity"] = (row["flops_est_per_call"] / row["bytes"]
+                            if row["bytes"] else 0.0)
+        seconds = row["time_us"] / 1e6
+        achieved = (row["flops"] * executions / seconds
+                    if seconds > 0 else 0.0)
+        row["mfu"] = (achieved / peak_flops if peak_flops else 0.0)
+    rows.sort(key=lambda r: -r["time_us"])
+
+    unmatched = sorted(
+        ({"name": name, "time_us": round(e["time_us"], 3),
+          "calls": int(e["calls"])}
+         for name, e in events.items() if name not in costs),
+        key=lambda r: -r["time_us"])
+
+    worst = None
+    for row in rows:
+        if row["mfu"] <= 0 or row["time_share"] < WORST_MIN_TIME_SHARE:
+            continue
+        if worst is None or row["mfu"] < worst["mfu"]:
+            worst = row
+    dominant = rows[0] if rows else None
+    return {
+        "schema_version": _SCHEMA_VERSION,
+        "executions": executions,
+        "flops_total": flops_total,
+        "flops_est_total": est_total,
+        "flops_scale": scale,
+        "peak_flops": peak_flops,
+        "matched_time_us": round(matched_time, 3),
+        "matched_time_frac": (matched_time / window_time_us
+                              if window_time_us else 0.0),
+        "kernels": rows,
+        "unmatched_events": unmatched[:16],
+        "worst_kernel": worst["name"] if worst else None,
+        "worst_kernel_mfu": worst["mfu"] if worst else None,
+        "dominant_kernel": dominant["name"] if dominant else None,
+        "dominant_time_share": (dominant["time_share"] if dominant
+                                else None),
+    }
+
+
+def write_kernels_json(logdir: str, table: dict,
+                       extra: Optional[dict] = None) -> str:
+    """Atomically persist the kernel table as
+    ``<logdir>/kernels.json`` (the artifact obs/report.py reads)."""
+    payload = dict(table)
+    if extra:
+        payload.update(extra)
+    path = os.path.join(logdir, KERNELS_JSON_NAME)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+# -- registry export + verdict hand-off --------------------------------------
+
+# Last published verdict, gated on registry identity like the ledger's
+# stall hand-off: the stall attributor (obs/stall.py) reads it to name
+# the worst kernel inside a device_bound verdict, and a table published
+# against a private registry must not leak into another run's verdict.
+_last_lock = threading.Lock()
+_last: Dict[str, object] = {}
+
+
+def publish_kernel_metrics(table: dict, registry=None) -> None:
+    """Fold the table head into the metrics registry: per-kernel
+    ``kernel/<name>/mfu`` + ``kernel/<name>/time_share`` gauges for the
+    top ``PUBLISH_TOP_N`` kernels by time, plus the verdict gauges
+    ``kernel/worst_mfu`` / ``kernel/dominant_time_share`` and the
+    match-coverage gauge.  Fleet folds (obs/aggregate.py): every
+    ``kernel/*`` series takes the MAX — the busiest/most-telling
+    process wins, and the worst-kernel label rides the per-kernel
+    series names."""
+    from scalable_agent_tpu.obs.registry import get_registry
+
+    registry = registry or get_registry()
+    for row in table["kernels"][:PUBLISH_TOP_N]:
+        registry.gauge(
+            f"kernel/{row['name']}/mfu",
+            "roofline MFU of this kernel in the last profile window"
+        ).set(row["mfu"])
+        registry.gauge(
+            f"kernel/{row['name']}/time_share",
+            "share of matched device time in the last profile window"
+        ).set(row["time_share"])
+    if table.get("worst_kernel") is not None:
+        registry.gauge(
+            "kernel/worst_mfu",
+            "lowest roofline MFU among kernels above the time-share "
+            "floor (the roofline target)").set(
+                table["worst_kernel_mfu"] or 0.0)
+    if table.get("dominant_kernel") is not None:
+        registry.gauge(
+            "kernel/dominant_time_share",
+            "time share of the single largest kernel").set(
+                table["dominant_time_share"] or 0.0)
+    registry.gauge(
+        "kernel/matched_time_frac",
+        "fraction of trace event time joined to an HLO kernel").set(
+            table.get("matched_time_frac", 0.0))
+    with _last_lock:
+        _last["registry"] = registry
+        _last["worst"] = ((table["worst_kernel"],
+                           table["worst_kernel_mfu"])
+                          if table.get("worst_kernel") else None)
+        _last["dominant"] = ((table["dominant_kernel"],
+                              table["dominant_time_share"])
+                             if table.get("dominant_kernel") else None)
+
+
+def last_worst(registry) -> Optional[Tuple[str, float]]:
+    """(name, mfu) of the worst kernel from the last table published
+    against ``registry``; None when none was, or it was another
+    registry's."""
+    with _last_lock:
+        if _last.get("registry") is not registry:
+            return None
+        return _last.get("worst")
+
+
+def last_dominant(registry) -> Optional[Tuple[str, float]]:
+    with _last_lock:
+        if _last.get("registry") is not registry:
+            return None
+        return _last.get("dominant")
+
+
+# -- the driver entry point --------------------------------------------------
+
+
+def harvest(profile_dir: str, hlo_text: str, flops_total: float,
+            peak_flops: Optional[float], logdir: Optional[str],
+            registry=None, executions: int = 1,
+            extra: Optional[dict] = None) -> Optional[dict]:
+    """Build + persist + publish the kernel ledger for one profile
+    window.  Returns the table, or None when the window left no trace
+    files (the profiler can fail silently on exotic backends) — never
+    raises on missing artifacts, this runs on the driver's teardown-
+    adjacent path."""
+    traces = find_profiler_traces(profile_dir)
+    if not traces:
+        return None
+    module = hlo_module_name(hlo_text)
+    events: Dict[str, Dict[str, float]] = {}
+    for path in traces:
+        try:
+            for name, entry in load_trace_kernel_events(
+                    path, module=module).items():
+                agg = events.setdefault(name,
+                                        {"time_us": 0.0, "calls": 0.0})
+                agg["time_us"] += entry["time_us"]
+                agg["calls"] += entry["calls"]
+        except (OSError, json.JSONDecodeError):
+            continue
+    if not events:
+        return None
+    costs = parse_hlo_kernel_costs(hlo_text)
+    table = build_kernel_table(events, costs, flops_total=flops_total,
+                               peak_flops=peak_flops,
+                               executions=executions)
+    if logdir:
+        write_kernels_json(logdir, table, extra=extra)
+    publish_kernel_metrics(table, registry=registry)
+    return table

@@ -4,9 +4,11 @@ the next perf PR.
 ::
 
     python -m scalable_agent_tpu.obs.report <logdir>
+    python -m scalable_agent_tpu.obs.report --json <logdir>
 
 renders, from a run's on-disk artifacts (``metrics*.prom``,
-``ledger.p*.json`` — no jax, run it on a laptop against rsync'd files):
+``ledger.p*.json``, ``kernels.json`` — no jax, run it on a laptop
+against rsync'd files):
 
 - the **stage table**: per ledger segment (obs/ledger.py SEGMENTS), the
   arrival rate, mean/p95 latency, occupancy ρ (Little's-law L for wait
@@ -16,7 +18,17 @@ renders, from a run's on-disk artifacts (``metrics*.prom``,
 - the **live MFU** gauge and actor-vs-learner FPS;
 - the stall verdict and a **top recommendation** keyed on the
   dominant-latency stage — the same attribution the verdict log line
-  carries, expanded into the concrete next fix.
+  carries, expanded into the concrete next fix;
+- the **worst kernels** section (obs/kernels.py): the per-kernel
+  roofline table from the run's ``kernels.json`` when a ``--profile_
+  dir`` window captured one, plus the newest committed ``BENCH_r*.
+  json``'s ``kernel_*`` readings — so the report names the roofline
+  target (``conv0_gradw`` at 0.107 MFU in r04/r05) without anyone
+  reading bench output by hand.
+
+``--json`` emits the same verdicts as one machine-readable object
+(``build_report``), so CI and the bench tooling consume the report
+without scraping text.
 
 Multi-process logdirs are folded on the fly with obs/aggregate.py's
 fold rules (rates sum, ρ max, staleness quantiles max) when
@@ -28,7 +40,8 @@ import argparse
 import glob
 import json
 import os
-from typing import Dict, Optional, Sequence, Tuple
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from scalable_agent_tpu.obs.aggregate import (
     FLEET_PROM_NAME,
@@ -37,6 +50,7 @@ from scalable_agent_tpu.obs.aggregate import (
     parse_prometheus,
 )
 from scalable_agent_tpu.obs.exporters import _prom_name
+from scalable_agent_tpu.obs.kernels import KERNELS_JSON_NAME
 from scalable_agent_tpu.obs.ledger import (
     SEGMENT_LABELS,
     SEGMENTS,
@@ -44,7 +58,7 @@ from scalable_agent_tpu.obs.ledger import (
     SERVICE_UTILIZATION_STAGES,
 )
 
-__all__ = ["main", "render_report"]
+__all__ = ["build_report", "main", "render_report"]
 
 # Dominant-latency stage -> the concrete next fix.  This is the
 # queueing-model reading of BENCH_r04's 200x gap: name the stage that
@@ -76,7 +90,8 @@ RECOMMENDATIONS = {
     "device": (
         "device execution dominates — the pipeline is healthy and the "
         "chip is the constraint: faster kernels (core_impl=pallas, "
-        "bf16), larger batch, bigger mesh"),
+        "bf16), larger batch, bigger mesh — profile a window "
+        "(--profile_dir) and read the worst-kernels section below"),
     "inference_service": (
         "the dynamic-batching inference service saturates: more "
         "consumers, larger max batch, or accum-mode actors"),
@@ -95,6 +110,11 @@ RECOMMENDATIONS = {
         "rollouts / item 4 serving engine)"),
 }
 
+# Where the committed BENCH_r*.json artifacts live when the report runs
+# from a checkout (obs/ -> scalable_agent_tpu/ -> repo root).  Callers
+# outside a checkout pass --bench_dir or get no bench-kernel section.
+_DEFAULT_BENCH_DIR = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 def _load_families(logdir: str) -> Tuple[Dict[str, dict], str]:
     """Parsed prometheus families for the logdir, folding multi-process
@@ -158,74 +178,159 @@ def _ledger_artifacts(logdir: str) -> list:
     return out
 
 
-def _fmt(value: Optional[float], spec: str = "8.3f") -> str:
-    if value is None:
-        width = spec.split(".")[0]
-        return " " * (int(width) - 1 if width else 0) + "-"
-    return format(value, spec)
+# -- kernel sections ---------------------------------------------------------
 
 
-def render_report(logdir: str) -> str:
+def _run_kernels(logdir: str) -> Optional[dict]:
+    """The run's own per-kernel roofline table (``kernels.json``,
+    written by a --profile_dir window — obs/kernels.py)."""
+    path = os.path.join(logdir, KERNELS_JSON_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        table = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return None
+    rows = [
+        {"name": row.get("name"),
+         "time_us": row.get("time_us"),
+         "time_share": row.get("time_share"),
+         "calls": row.get("calls"),
+         "flops": row.get("flops"),
+         "intensity": row.get("intensity"),
+         "mfu": row.get("mfu")}
+        for row in table.get("kernels", [])
+    ]
+    return {
+        "source": KERNELS_JSON_NAME,
+        "rows": rows,
+        "flops_total": table.get("flops_total"),
+        "matched_time_frac": table.get("matched_time_frac"),
+        "dominant": table.get("dominant_kernel"),
+        "dominant_time_share": table.get("dominant_time_share"),
+        "worst": table.get("worst_kernel"),
+        "worst_mfu": table.get("worst_kernel_mfu"),
+    }
+
+
+# Tolerates both plain JSON (`"kernel_x_us": 1.2`) and the escaped
+# form inside a tail-embedded fragment (`\"kernel_x_us\": 1.2`).
+_BENCH_KERNEL_SERIES_RE = re.compile(
+    r'\\?"kernel_(?P<name>[A-Za-z0-9_]+?)_(?P<kind>us|mfu)\\?"\s*:\s*'
+    r'(?P<value>-?[0-9][0-9.eE+\-]*)')
+
+
+def _bench_kernels(bench_dir: Optional[str]) -> Optional[dict]:
+    """Per-kernel readings from the newest committed bench artifact
+    that has any ``kernel_<name>_us``/``kernel_<name>_mfu`` keys —
+    the hand-measured rooflines (BENCH_r04/r05 found ``conv0_gradw``
+    at 0.107 MFU) surfaced automatically.
+
+    Scans the RAW file text rather than parsing JSON: committed
+    artifacts come in three formats (the bench's one JSON line, the
+    driver's ``{"parsed": ...}`` wrapper, and a tail-embedded fragment
+    that may be TRUNCATED mid-line — BENCH_r05 is), and the kernel
+    series appear as ``"kernel_x_us": 1.2`` pairs in all of them."""
+    bench_dir = bench_dir or _DEFAULT_BENCH_DIR
+    files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
+    for path in reversed(files):  # newest artifact with kernel keys wins
+        try:
+            text = open(path).read()
+        except OSError:
+            continue
+        kernels: Dict[str, dict] = {}
+        for match in _BENCH_KERNEL_SERIES_RE.finditer(text):
+            try:
+                value = float(match.group("value"))
+            except ValueError:
+                continue
+            entry = kernels.setdefault(match.group("name"), {})
+            entry[match.group("kind")] = value
+        if not kernels:
+            continue
+        rows = [{"name": name, "time_us": entry.get("us"),
+                 "mfu": entry.get("mfu")}
+                for name, entry in sorted(
+                    kernels.items(),
+                    key=lambda item: -(item[1].get("us") or 0.0))]
+        # The verdict considers only PRIMARY kernels: a reading whose
+        # name extends another's with a suffix (conv0_gradw_s2d,
+        # lstm_grad_pallas_bf16, ..._b256) is an experiment variant of
+        # that measurement — it stays in the table but must not claim
+        # the roofline-target verdict over the production path.
+        primaries = {
+            name for name in kernels
+            if not any(name != other and name.startswith(other + "_")
+                       for other in kernels)}
+        candidates = [r for r in rows if r["name"] in primaries]
+        with_mfu = [r for r in candidates if r["mfu"] is not None]
+        worst = min(with_mfu, key=lambda r: r["mfu"], default=None)
+        dominant = max(candidates, key=lambda r: r["time_us"] or 0.0,
+                       default=None)
+        return {
+            "source": os.path.basename(path),
+            "rows": rows,
+            "worst": worst["name"] if worst else None,
+            "worst_mfu": worst["mfu"] if worst else None,
+            "dominant": dominant["name"] if dominant else None,
+        }
+    return None
+
+
+# -- the machine-readable report ---------------------------------------------
+
+
+def build_report(logdir: str,
+                 bench_dir: Optional[str] = None) -> dict:
+    """Everything the text report says, as one JSON-able object — the
+    ``--json`` payload CI and the bench tooling consume."""
     families, source = _load_families(logdir)
-    lines = [f"Pipeline ledger report — {logdir}",
-             f"source: {source}", ""]
+    report: dict = {"logdir": logdir, "source": source}
 
-    header = (f"{'stage':<18}{'rate/s':>9}{'mean_s':>10}{'p95_s':>10}"
-              f"{'rho(L)':>9}{'share':>8}  where")
-    lines.append(header)
-    lines.append("-" * len(header))
+    stages = {}
     shares = {}
     for name, _, _ in SEGMENTS:
-        rate = _value(families, f"ledger/rate/{name}_per_s")
-        rho = _value(families, f"ledger/rho/{name}")
-        share = _value(families, f"ledger/latency_share/{name}")
         total = _value(families, f"ledger/stage/{name}_s", suffix="_sum")
         count = _value(families, f"ledger/stage/{name}_s",
                        suffix="_count")
-        mean = (total / count) if total is not None and count else None
-        p95 = _value(families, f"ledger/stage/{name}_s", quantile="0.95")
+        share = _value(families, f"ledger/latency_share/{name}")
         if share is not None:
             shares[name] = share
-        lines.append(
-            f"{name:<18}{_fmt(rate, '9.2f')}{_fmt(mean, '10.4f')}"
-            f"{_fmt(p95, '10.4f')}{_fmt(rho, '9.3f')}"
-            f"{_fmt(share * 100 if share is not None else None, '7.1f')}%"
-            f"  {SEGMENT_LABELS[name]}")
+        stages[name] = {
+            "rate_per_s": _value(families, f"ledger/rate/{name}_per_s"),
+            "rho": _value(families, f"ledger/rho/{name}"),
+            "mean_s": ((total / count)
+                       if total is not None and count else None),
+            "p95_s": _value(families, f"ledger/stage/{name}_s",
+                            quantile="0.95"),
+            "latency_share": share,
+            "label": SEGMENT_LABELS[name],
+        }
+    report["stages"] = stages
+
+    service = {}
     for name in SERVICE_STAGES:
         rate = _value(families, f"ledger/rate/{name}_per_s")
         rho = _value(families, f"ledger/rho/{name}")
         if not rate and not rho:
             continue
-        lines.append(
-            f"{name:<18}{_fmt(rate, '9.2f')}{'-':>10}{'-':>10}"
-            f"{_fmt(rho, '9.3f')}{'-':>7}   {SEGMENT_LABELS[name]}")
-    lines.append("")
+        service[name] = {"rate_per_s": rate, "rho": rho,
+                         "label": SEGMENT_LABELS[name]}
+    report["service_stages"] = service
 
-    staleness = {q: _value(families, "ledger/staleness_s", quantile=q)
-                 for q in ("0.5", "0.95", "0.99")}
-    if any(v is not None for v in staleness.values()):
-        labels = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}
-        lines.append(
-            "staleness (frame age at consumption): "
-            + "  ".join(f"{labels[q]} {_fmt(staleness[q], '.3f')}s"
-                        for q in ("0.5", "0.95", "0.99")))
-    mfu = _value(families, "ledger/mfu")
-    learner_fps = _value(families, "learner/fps")
-    actor_fps = _value(families, "actor/fps")
-    lines.append(
-        f"mfu: {_fmt(mfu, '.4g') if mfu is not None else 'n/a'}   "
-        f"learner fps: {_fmt(learner_fps, '.0f')}   "
-        f"actor fps: {_fmt(actor_fps, '.0f')}")
-
-    opened = _value(families, "ledger/trajectories_opened_total")
-    retired = _value(families, "ledger/trajectories_retired_total")
-    discarded = _value(families, "ledger/frames_discarded_total")
-    open_now = _value(families, "ledger/open_records")
-    lines.append(
-        f"trajectories: {_fmt(opened, '.0f')} opened, "
-        f"{_fmt(retired, '.0f')} retired, "
-        f"{_fmt(discarded, '.0f')} frames discarded, "
-        f"{_fmt(open_now, '.0f')} open")
+    report["staleness_s"] = {
+        q: _value(families, "ledger/staleness_s", quantile=q)
+        for q in ("0.5", "0.95", "0.99")}
+    report["mfu"] = _value(families, "ledger/mfu")
+    report["learner_fps"] = _value(families, "learner/fps")
+    report["actor_fps"] = _value(families, "actor/fps")
+    report["trajectories"] = {
+        "opened": _value(families, "ledger/trajectories_opened_total"),
+        "retired": _value(families, "ledger/trajectories_retired_total"),
+        "frames_discarded": _value(families,
+                                   "ledger/frames_discarded_total"),
+        "open": _value(families, "ledger/open_records"),
+    }
 
     verdict = None
     for category in ("device_bound", "env_bound", "learner_starved",
@@ -233,65 +338,224 @@ def render_report(logdir: str) -> str:
         flag = _value(families, f"stall/is_{category}")
         if flag == 1.0:
             verdict = category
-    if verdict:
-        lines.append(f"stall verdict: {verdict}")
+    report["stall_verdict"] = verdict
 
-    if shares:
-        dominant = max(shares, key=shares.get)
+    dominant = max(shares, key=shares.get) if shares else None
+    report["dominant_stage"] = (
+        {"name": dominant, "share": shares[dominant]}
+        if dominant else None)
+    report["recommendation"] = (
+        RECOMMENDATIONS.get(dominant, "inspect the stage table")
+        if dominant else None)
+    pressure = None
+    if dominant == "unroll":
+        util = {
+            name: _value(families, f"ledger/rho/{name}")
+            for name in SERVICE_UTILIZATION_STAGES
+        }
+        util = {k: v for k, v in util.items() if v is not None}
+        if util:
+            busiest = max(util, key=util.get)
+            if util[busiest] >= 0.5:
+                pressure = {"name": busiest, "rho": util[busiest]}
+    report["service_pressure"] = pressure
+
+    report["ledger_artifacts"] = [
+        {"process_index": a.get("process_index"),
+         "opened": a.get("counters", {}).get("opened", 0),
+         "abandoned": a.get("counters", {}).get("abandoned", 0),
+         "truncated": bool(a.get("ring_truncated")
+                           or a.get("counters", {}).get("dropped"))}
+        for a in _ledger_artifacts(logdir)]
+
+    # Device telemetry headline (devtel/* gauges published by the
+    # driver's log-interval fetch): surfaced so the fused backend's
+    # episode stream is part of the verdict document.
+    devtel = {}
+    for key, registry_name in (
+            ("env_episodes", "devtel/env/episodes"),
+            ("env_episode_return_mean", "devtel/env/episode_return/mean"),
+            ("env_episode_length_mean", "devtel/env/episode_length/mean"),
+            ("learner_updates", "devtel/learner/updates"),
+            ("learner_skipped", "devtel/learner/skipped"),
+            ("learner_loss", "devtel/learner/loss")):
+        value = _value(families, registry_name)
+        if value is not None:
+            devtel[key] = value
+    report["devtel"] = devtel or None
+
+    report["kernels"] = _run_kernels(logdir)
+    report["bench_kernels"] = _bench_kernels(bench_dir)
+    return report
+
+
+# -- the human-readable report -----------------------------------------------
+
+
+def _fmt(value: Optional[float], spec: str = "8.3f") -> str:
+    if value is None:
+        width = spec.split(".")[0]
+        return " " * (int(width) - 1 if width else 0) + "-"
+    return format(value, spec)
+
+
+def _render_kernel_section(lines: List[str], section: dict,
+                           heading: str):
+    lines.append("")
+    lines.append(f"{heading} — source: {section['source']}")
+    header = (f"  {'kernel':<28}{'time_us':>12}{'share':>8}"
+              f"{'mfu':>8}")
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    for row in section["rows"][:10]:
+        share = row.get("time_share")
         lines.append(
-            f"dominant stage: {dominant} "
-            f"({shares[dominant]:.0%} of frame latency in "
-            f"{SEGMENT_LABELS[dominant]})")
+            f"  {str(row['name'])[:28]:<28}"
+            f"{_fmt(row.get('time_us'), '12.1f')}"
+            f"{_fmt(share * 100 if share is not None else None, '7.1f')}%"
+            f"{_fmt(row.get('mfu'), '8.3f')}")
+    if section.get("worst"):
         lines.append(
-            "top recommendation: "
-            + RECOMMENDATIONS.get(dominant, "inspect the stage table"))
+            f"  worst kernel: {section['worst']} "
+            f"(mfu {_fmt(section.get('worst_mfu'), '.3f')}) — the "
+            f"roofline target (ROADMAP item 3)")
+    if section.get("dominant"):
+        lines.append(f"  dominant kernel: {section['dominant']}")
+
+
+def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
+    report = build_report(logdir, bench_dir=bench_dir)
+    lines = [f"Pipeline ledger report — {logdir}",
+             f"source: {report['source']}", ""]
+
+    header = (f"{'stage':<18}{'rate/s':>9}{'mean_s':>10}{'p95_s':>10}"
+              f"{'rho(L)':>9}{'share':>8}  where")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, _, _ in SEGMENTS:
+        stage = report["stages"][name]
+        share = stage["latency_share"]
+        lines.append(
+            f"{name:<18}{_fmt(stage['rate_per_s'], '9.2f')}"
+            f"{_fmt(stage['mean_s'], '10.4f')}"
+            f"{_fmt(stage['p95_s'], '10.4f')}"
+            f"{_fmt(stage['rho'], '9.3f')}"
+            f"{_fmt(share * 100 if share is not None else None, '7.1f')}%"
+            f"  {SEGMENT_LABELS[name]}")
+    for name in SERVICE_STAGES:
+        stage = report["service_stages"].get(name)
+        if stage is None:
+            continue
+        lines.append(
+            f"{name:<18}{_fmt(stage['rate_per_s'], '9.2f')}"
+            f"{'-':>10}{'-':>10}"
+            f"{_fmt(stage['rho'], '9.3f')}{'-':>7}   "
+            f"{SEGMENT_LABELS[name]}")
+    lines.append("")
+
+    staleness = report["staleness_s"]
+    if any(v is not None for v in staleness.values()):
+        labels = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}
+        lines.append(
+            "staleness (frame age at consumption): "
+            + "  ".join(f"{labels[q]} {_fmt(staleness[q], '.3f')}s"
+                        for q in ("0.5", "0.95", "0.99")))
+    mfu = report["mfu"]
+    lines.append(
+        f"mfu: {_fmt(mfu, '.4g') if mfu is not None else 'n/a'}   "
+        f"learner fps: {_fmt(report['learner_fps'], '.0f')}   "
+        f"actor fps: {_fmt(report['actor_fps'], '.0f')}")
+
+    trajectories = report["trajectories"]
+    lines.append(
+        f"trajectories: {_fmt(trajectories['opened'], '.0f')} opened, "
+        f"{_fmt(trajectories['retired'], '.0f')} retired, "
+        f"{_fmt(trajectories['frames_discarded'], '.0f')} frames "
+        f"discarded, "
+        f"{_fmt(trajectories['open'], '.0f')} open")
+
+    if report["stall_verdict"]:
+        lines.append(f"stall verdict: {report['stall_verdict']}")
+
+    dominant = report["dominant_stage"]
+    if dominant:
+        lines.append(
+            f"dominant stage: {dominant['name']} "
+            f"({dominant['share']:.0%} of frame latency in "
+            f"{SEGMENT_LABELS[dominant['name']]})")
+        lines.append("top recommendation: " + report["recommendation"])
         # The inference service runs INSIDE the unroll segment, so a
         # saturated service reads as "unroll" in the latency shares —
         # its ρ names the real constraint (runtime/service.py).
-        if dominant == "unroll":
-            util = {
-                name: _value(families, f"ledger/rho/{name}")
-                for name in SERVICE_UTILIZATION_STAGES
-            }
-            util = {k: v for k, v in util.items() if v is not None}
-            if util:
-                busiest = max(util, key=util.get)
-                if util[busiest] >= 0.5:
-                    lines.append(
-                        f"service-dominated: {busiest} rho "
-                        f"{util[busiest]:.2f} — "
-                        + RECOMMENDATIONS.get(
-                            busiest, "inspect the service rows"))
+        pressure = report["service_pressure"]
+        if pressure:
+            lines.append(
+                f"service-dominated: {pressure['name']} rho "
+                f"{pressure['rho']:.2f} — "
+                + RECOMMENDATIONS.get(
+                    pressure["name"], "inspect the service rows"))
     else:
         lines.append(
             "dominant stage: n/a (no closed ledger records published — "
             "did the run retire any updates?)")
 
-    ledgers = _ledger_artifacts(logdir)
-    for artifact in ledgers:
-        extra = ""
-        if artifact.get("ring_truncated") or any(
-                artifact.get("counters", {}).get(k)
-                for k in ("dropped",)):
-            extra = " [TRUNCATED window]"
+    devtel = report["devtel"]
+    if devtel:
+        parts = []
+        if "learner_updates" in devtel:
+            parts.append(f"updates {devtel['learner_updates']:.0f}")
+        if "learner_skipped" in devtel:
+            parts.append(f"skipped {devtel['learner_skipped']:.0f}")
+        if "env_episodes" in devtel:
+            parts.append(f"episodes {devtel['env_episodes']:.0f}")
+        if "env_episode_return_mean" in devtel:
+            parts.append(
+                f"mean return {devtel['env_episode_return_mean']:.3f}")
+        if "env_episode_length_mean" in devtel:
+            parts.append(
+                f"mean length {devtel['env_episode_length_mean']:.1f}")
+        lines.append("device telemetry: " + ", ".join(parts))
+
+    for artifact in report["ledger_artifacts"]:
+        extra = " [TRUNCATED window]" if artifact["truncated"] else ""
         lines.append(
-            f"ledger artifact p{artifact.get('process_index')}: "
-            f"{artifact.get('counters', {}).get('opened', 0):.0f} "
-            f"records, "
-            f"{artifact.get('counters', {}).get('abandoned', 0):.0f} "
-            f"abandoned at shutdown{extra}")
+            f"ledger artifact p{artifact['process_index']}: "
+            f"{artifact['opened']:.0f} records, "
+            f"{artifact['abandoned']:.0f} abandoned at shutdown{extra}")
+
+    if report["kernels"]:
+        _render_kernel_section(
+            lines, report["kernels"],
+            "worst kernels (this run's profile window)")
+    if report["bench_kernels"]:
+        _render_kernel_section(
+            lines, report["bench_kernels"],
+            "worst kernels (newest bench artifact)")
     return "\n".join(lines) + "\n"
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="Render the pipeline-ledger gap report (stage "
-                    "table, staleness, MFU, top recommendation) from a "
-                    "run logdir's prom/ledger artifacts.  jax-free.")
+                    "table, staleness, MFU, worst kernels, top "
+                    "recommendation) from a run logdir's prom/ledger/"
+                    "kernel artifacts.  jax-free.")
     parser.add_argument("logdir", help="run log directory")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable report object "
+                             "instead of text")
+    parser.add_argument("--bench_dir", default=None,
+                        help="directory holding committed BENCH_r*.json "
+                             "artifacts (default: the repo root)")
     args = parser.parse_args(argv)
     try:
-        print(render_report(args.logdir), end="")
+        if args.json:
+            print(json.dumps(build_report(args.logdir,
+                                          bench_dir=args.bench_dir),
+                             indent=1))
+        else:
+            print(render_report(args.logdir, bench_dir=args.bench_dir),
+                  end="")
     except FileNotFoundError as exc:
         print(str(exc))
         return 1

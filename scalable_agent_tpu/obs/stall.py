@@ -167,6 +167,17 @@ class StallAttributor:
             if pressure is not None:
                 evidence["ledger_service"] = pressure[0]
                 evidence["ledger_service_rho"] = pressure[1]
+        if category == "device_bound":
+            # A device-bound verdict's actionable next step is a kernel
+            # name: when a --profile_dir window published a kernel
+            # ledger against THIS registry (obs/kernels.py), carry its
+            # worst-kernel verdict into the evidence/log line.
+            from scalable_agent_tpu.obs import kernels as kernels_lib
+
+            worst = kernels_lib.last_worst(self._registry)
+            if worst is not None:
+                evidence["kernel_worst"] = worst[0]
+                evidence["kernel_worst_mfu"] = worst[1]
         return category, evidence
 
     def report_stalled(self, stalled: Dict[str, float],
@@ -209,6 +220,11 @@ class StallAttributor:
         if service:
             rho = fractions.get("ledger_service_rho", 0.0)
             ledger_part += f"; service {service} rho {rho:.2f}"
+        worst_kernel = fractions.get("kernel_worst")
+        if worst_kernel:
+            ledger_part += (
+                f"; worst kernel {worst_kernel} mfu "
+                f"{fractions.get('kernel_worst_mfu', 0.0):.3f}")
         return (f"pipeline {category} "
                 f"(wait_batch {fractions['wait_frac']:.0%} of learner "
                 f"interval; actor env share "

@@ -19,16 +19,25 @@ Per-update semantics match the host pipeline:
   on the on/off-policy spectrum the data sits.
 """
 
-from typing import NamedTuple, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scalable_agent_tpu.envs.device import (
+    env_telemetry_spec,
+    record_episode_telemetry,
+)
 from scalable_agent_tpu.models.agent import (
     ImpalaAgent,
     actor_step,
     initial_state,
+)
+from scalable_agent_tpu.obs.device_telemetry import (
+    TelemetryPublisher,
+    fetch_merged,
+    merge_init,
 )
 from scalable_agent_tpu.runtime.learner import Learner, Trajectory
 from scalable_agent_tpu.types import AgentOutput, AgentState
@@ -41,6 +50,18 @@ class RolloutCarry(NamedTuple):
     env_output: object  # StepOutput
     agent_output: AgentOutput
     core_state: AgentState
+
+
+class TrainCarry(NamedTuple):
+    """The fused step's full donated carry: the rollout state plus the
+    device-telemetry pytree (obs/device_telemetry.py) — env episode
+    instruments and the learner's update instruments accumulate inside
+    the same jitted program, in the same donated buffers, and the host
+    fetches them only at log-interval cadence.  This is how the fused
+    megastep keeps a live obs plane with zero per-update host sync."""
+
+    rollout: RolloutCarry
+    telemetry: Dict
 
 
 def _stack_first(first, seq):
@@ -82,12 +103,17 @@ class InGraphTrainer:
 
         self._batch_sharding = batch_sharding(
             learner.mesh, batch_axis_index=0)
+        self._env_tel_spec = env_telemetry_spec()
+        self._tel_specs = [self._env_tel_spec]
+        if not learner.devtel_spec.empty:
+            self._tel_specs.append(learner.devtel_spec)
+        self._tel_publisher = TelemetryPublisher(self._tel_specs)
         self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
 
     # -- initialization ----------------------------------------------------
 
-    def init(self, rng: jax.Array) -> Tuple[object, RolloutCarry]:
-        """(TrainState, RolloutCarry) ready for ``train_step``."""
+    def init(self, rng: jax.Array) -> Tuple[object, TrainCarry]:
+        """(TrainState, TrainCarry) ready for ``train_step``."""
         seeds = np.arange(self._batch, dtype=np.int32) + self._seed
         env_state, env_output = self._env.initial(seeds)
         agent_output = AgentOutput(
@@ -97,8 +123,10 @@ class InGraphTrainer:
             baseline=jnp.zeros((self._batch,), jnp.float32),
         )
         core_state = initial_state(self._batch, self._agent.core_size)
-        carry = RolloutCarry(env_state, env_output, agent_output,
-                             core_state)
+        carry = TrainCarry(
+            rollout=RolloutCarry(env_state, env_output, agent_output,
+                                 core_state),
+            telemetry=merge_init(self._tel_specs))
         example = Trajectory(
             agent_state=core_state,
             env_outputs=_stack_first(
@@ -143,28 +171,42 @@ class InGraphTrainer:
             else jax.lax.with_sharding_constraint(x, self._batch_sharding),
             tree, is_leaf=lambda x: x is None)
 
-    def _fused(self, state, carry: RolloutCarry, counter):
+    def _fused(self, state, carry: TrainCarry, counter):
         rng = jax.random.fold_in(
             jax.random.key(self._seed), counter)
-        carry = self._constrain_batch(carry)
-        trajectory, new_carry = self._rollout(state.params, carry, rng)
-        new_state, metrics = self._learner._update_impl(state, trajectory)
+        # Only the rollout state takes the batch-sharding constraint:
+        # the telemetry leaves are replicated scalars/bucket vectors
+        # with no batch axis.
+        rollout_carry = self._constrain_batch(carry.rollout)
+        telemetry = carry.telemetry
+        trajectory, new_rollout = self._rollout(
+            state.params, rollout_carry, rng)
+        # The [1:] slice drops the T+1 overlap entry (it was the
+        # PREVIOUS unroll's last step — counting it again would
+        # double-book every episode boundary), for both the metrics
+        # accounting below and the device telemetry.
+        emitted = jax.tree_util.tree_map(
+            lambda t: None if t is None else t[1:],
+            trajectory.env_outputs, is_leaf=lambda x: x is None)
+        telemetry = record_episode_telemetry(
+            self._env_tel_spec, telemetry, emitted)
+        new_state, telemetry, metrics = self._learner._update_impl(
+            state, trajectory, telemetry)
         # Episode accounting from the on-device env stream (the host
         # backend reads MultiEnv ring buffers; here the trajectory
         # itself carries the emitted per-done episode stats).  Consumers
         # gate on episodes_completed > 0 before trusting the means.
-        done = trajectory.env_outputs.done[1:]
-        steps = trajectory.env_outputs.info.episode_step[1:]
+        done = emitted.done
+        steps = emitted.info.episode_step
         finished = jnp.logical_and(done, steps > 0)
         count = jnp.sum(finished)
         denom = jnp.maximum(count, 1).astype(jnp.float32)
         metrics["episodes_completed"] = count
         metrics["episode_return"] = jnp.sum(jnp.where(
-            finished, trajectory.env_outputs.info.episode_return[1:],
-            0.0)) / denom
+            finished, emitted.info.episode_return, 0.0)) / denom
         metrics["episode_frames"] = jnp.sum(jnp.where(
             finished, steps, 0)).astype(jnp.float32) / denom
-        return new_state, new_carry, metrics
+        return new_state, TrainCarry(new_rollout, telemetry), metrics
 
     # -- host loop ---------------------------------------------------------
 
@@ -177,3 +219,17 @@ class InGraphTrainer:
             state, carry, metrics = self.train_step(
                 state, carry, np.int32(counter_start + i))
         return state, carry, metrics
+
+    # -- telemetry (host side, log-interval cadence) -----------------------
+
+    def fetch_telemetry(self, carry: TrainCarry) -> dict:
+        """Materialize every telemetry instrument riding ``carry`` —
+        the obs plane's ONE device→host sync, a few hundred bytes."""
+        return fetch_merged(self._tel_specs, carry.telemetry)
+
+    def publish_telemetry(self, carry: TrainCarry) -> dict:
+        """Fetch + fold into the metrics registry (``devtel/env/*`` and
+        ``devtel/learner/*`` ride the normal prom/report path)."""
+        fetched = self.fetch_telemetry(carry)
+        self._tel_publisher.publish(fetched)
+        return fetched

@@ -25,7 +25,7 @@ experiment.py:346-427), re-designed for TPU:
   parallel mesh axis for very long unrolls hooks in at ops/vtrace.py.
 """
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,10 @@ from scalable_agent_tpu.obs import (
     get_ledger,
     get_registry,
     get_tracer,
+)
+from scalable_agent_tpu.obs.device_telemetry import (
+    DeviceTelemetry,
+    TelemetryPublisher,
 )
 from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
@@ -110,6 +114,24 @@ _TRAJ_BATCH_AXES = Trajectory(agent_state=0, env_outputs=1,
 _broadcast_prefix = broadcast_prefix
 
 
+def learner_telemetry_spec() -> DeviceTelemetry:
+    """The learner's device-resident instrument set (obs/
+    device_telemetry.py): update/skip counters, the last loss, and a
+    log-bucketed grad-norm histogram — all accumulated INSIDE the
+    jitted update in donated buffers (the non-finite-counter pattern
+    generalized), fetched once per log interval."""
+    return (
+        DeviceTelemetry("learner")
+        .counter("updates", "update steps executed on device")
+        .counter("skipped", "updates the fused non-finite guard no-op'd")
+        .gauge("loss", "total_loss of the newest accumulated update")
+        .histogram(
+            "grad_norm",
+            (0.01, 0.1, 1.0, 10.0, 100.0, 1000.0, 10000.0),
+            "global grad norm per update, log-ish buckets")
+    )
+
+
 def _make_optimizer(hp: LearnerHyperparams) -> optax.GradientTransformation:
     # lr=1.0 here; the decayed lr is applied inside the update so it can be
     # keyed on env frames rather than update count (resume-exact, reference
@@ -151,6 +173,7 @@ class Learner:
         scan_impl: str = "auto",
         transport: str = "per_leaf",
         finite_guard: bool = True,
+        device_telemetry: bool = True,
     ):
         self._agent = agent
         self._hp = hp
@@ -205,9 +228,20 @@ class Learner:
         # (params/optimizer tensor-parallel over 'model', batch over
         # 'data'), and jit compiles the SPMD program from the argument
         # placements — no in_shardings pinning, so the same Learner
-        # serves any (data, model) mesh shape.
-        self._update = jax.jit(self._update_impl, donate_argnums=(0,))
+        # serves any (data, model) mesh shape.  The device-telemetry
+        # pytree (obs/device_telemetry.py) rides as a third DONATED
+        # argument: accumulation is in-place on device, and the host
+        # only touches it at the log-interval fetch.
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 2))
         self._replicated = replicated
+        self._devtel_enabled = bool(device_telemetry)
+        self._devtel_spec = (learner_telemetry_spec()
+                             if self._devtel_enabled
+                             else DeviceTelemetry("learner"))
+        self._devtel = self._place_replicated(self._devtel_spec.init())
+        self._devtel_publisher = (
+            TelemetryPublisher(self._devtel_spec)
+            if self._devtel_enabled else None)
         self._traj_shardings = traj_shardings
         # Host->device trajectory placement strategy: "per_leaf" (one
         # device_put per leaf — the seed path, bit-for-bit preserved) or
@@ -229,6 +263,68 @@ class Learner:
     def mesh(self):
         """The device mesh this learner's update is sharded over."""
         return self._mesh
+
+    # -- device telemetry --------------------------------------------------
+
+    def _place_replicated(self, tree):
+        """Commit a small host pytree replicated onto the mesh — the
+        multi-process path builds from local data (the place_state
+        discipline: device_put onto a non-addressable sharding runs a
+        hidden value-dependent collective)."""
+        if jax.process_count() <= 1:
+            return jax.device_put(tree, self._replicated)
+
+        def _place(x):
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, self._replicated,
+                lambda idx, _h=host: _h[idx])
+
+        return jax.tree_util.tree_map(_place, tree)
+
+    @property
+    def devtel_spec(self) -> DeviceTelemetry:
+        """The learner's device-telemetry spec (empty when disabled)."""
+        return self._devtel_spec
+
+    @property
+    def device_telemetry(self):
+        """The CURRENT device-resident telemetry buffers.  Callers
+        driving ``_update`` directly (bench AOT path, in-graph trainer)
+        thread this pytree themselves; everyone else just calls
+        ``update()``/``publish_device_telemetry()``."""
+        return self._devtel
+
+    def adopt_device_telemetry(self, devtel) -> None:
+        """Rebind the telemetry buffers.  Callers driving the RAW
+        jitted/AOT update themselves (bench's compiled wrapper, the
+        in-graph trainer) receive the donated-and-returned pytree from
+        each call; handing it back here keeps ``fetch_device_
+        telemetry`` reading live buffers instead of donated husks."""
+        self._devtel = devtel
+
+    def lower_update(self, state: "TrainState", trajectory: "Trajectory"):
+        """``jax.jit(...).lower`` of the update at these shapes — the
+        one sanctioned way to lower it (cost analysis for the MFU
+        gauge, HLO text for the kernel ledger) now that the jitted
+        signature carries the telemetry buffers."""
+        return self._update.lower(state, trajectory, self._devtel)
+
+    def fetch_device_telemetry(self) -> Optional[Dict[str, np.ndarray]]:
+        """Materialize the telemetry on the host — the ONE device→host
+        sync the telemetry ever causes, sized a few hundred bytes; the
+        driver calls it at log-interval cadence.  None when disabled."""
+        if not self._devtel_enabled:
+            return None
+        return self._devtel_spec.fetch(self._devtel)
+
+    def publish_device_telemetry(self) -> Optional[Dict[str, np.ndarray]]:
+        """Fetch + fold into the metrics registry (``devtel/learner/*``
+        names ride the normal prom/report/aggregate path)."""
+        fetched = self.fetch_device_telemetry()
+        if fetched is not None:
+            self._devtel_publisher.publish(fetched)
+        return fetched
 
     # -- state ------------------------------------------------------------
 
@@ -380,8 +476,13 @@ class Learner:
             "entropy_loss": entropy_loss,
         }
 
-    def _update_impl(self, state: TrainState, trajectory: Trajectory
-                     ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    def _update_impl(self, state: TrainState, trajectory: Trajectory,
+                     devtel: Dict
+                     ) -> Tuple[TrainState, Dict, Dict[str, jax.Array]]:
+        """One update.  ``devtel`` is the device-telemetry pytree
+        (donated; may carry other specs' leaves — e.g. the in-graph
+        trainer's env instruments — which pass through untouched).
+        Returns ``(new_state, new_devtel, metrics)``."""
         (_, metrics), grads = jax.value_and_grad(
             self._loss, has_aux=True)(state.params, trajectory)
 
@@ -438,7 +539,24 @@ class Learner:
             nonfinite_streak=streak,
         )
         metrics["env_frames"] = new_state.env_frames
-        return new_state, metrics
+        if self._devtel_enabled:
+            # Device telemetry: the same zero-host-sync contract as the
+            # non-finite counters — a few scalar adds and one bucketed
+            # observe fused into the update program.
+            spec = self._devtel_spec
+            devtel = spec.inc(devtel, "updates")
+            devtel = spec.set(devtel, "loss", metrics["total_loss"])
+            # A non-finite grad norm (the event the finite guard
+            # absorbs) must not reach the histogram: its ":sum" buffer
+            # is CUMULATIVE, so one NaN would poison every subsequent
+            # fetch of the run.
+            devtel = spec.observe(
+                devtel, "grad_norm", metrics["grad_norm"],
+                where=jnp.isfinite(metrics["grad_norm"]))
+            if self._finite_guard:
+                devtel = spec.inc(devtel, "skipped",
+                                  metrics["update_skipped"])
+        return new_state, devtel, metrics
 
     def update(self, state: TrainState, trajectory: Trajectory
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
@@ -453,7 +571,9 @@ class Learner:
                     reward=trajectory.env_outputs.reward
                     * jnp.float32(float("nan"))))
         with get_tracer().span("learner/update", cat="learner"):
-            out = self._update(state, trajectory)
+            new_state, self._devtel, metrics = self._update(
+                state, trajectory, self._devtel)
+            out = (new_state, metrics)
         self._updates_counter.inc()
         self._frames_counter.inc(self._frames_per_update)
         # Step-number breadcrumb: a crash dump's ring then pins exactly

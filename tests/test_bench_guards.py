@@ -535,3 +535,154 @@ class TestElasticRegressionGuard:
         bench.elastic_regression_guard(diag)
         assert diag["errors"] == []
         assert any("MTTR" in w for w in diag["warnings"])
+
+
+class TestDevtelRegressionGuard:
+    """ISSUE 12 satellite: device telemetry must stay under 1% of the
+    update stage (binding on TPU, advisory on the CPU fallback), with
+    obs-guard-style missing-key protection."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "devtel_accumulate_us": 5.0,
+                "devtel_fetch_us": 80.0,
+                "devtel_publish_us": 20.0}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self, tmp_path):
+        diag = self._diag(devtel_overhead_frac_on_update=0.05)
+        bench.devtel_regression_guard(diag, bench_dir=str(tmp_path))
+        assert any("DEVTEL" in e and "1%" in e for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self, tmp_path):
+        diag = self._diag(platform="cpu",
+                          devtel_overhead_frac_on_update=0.05)
+        bench.devtel_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert any("DEVTEL" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self, tmp_path):
+        diag = self._diag(devtel_overhead_frac_on_update=0.0005)
+        bench.devtel_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, devtel_overhead_frac_on_update=0.0005,
+            devtel_worst_case_frac_on_update=0.02,
+            devtel_accumulate_us=4.0, devtel_fetch_us=70.0,
+            devtel_publish_us=15.0)
+        diag = {"errors": [], "platform": "tpu"}  # stage vanished
+        bench.devtel_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "DEVTEL REGRESSION" in e and "missing" in e]
+        assert len(missing) == len(bench.DEVTEL_GUARD_KEYS)
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     devtel_accumulate_us=4.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.devtel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_runs_against_real_committed_artifacts(self):
+        diag = {"errors": [], "devtel_overhead_frac_on_update": 1e-5}
+        bench.devtel_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "DEVTEL REGRESSION" in e]
+
+
+class TestKernelRegressionGuard:
+    """ISSUE 12: any named kernel regressing vs the newest committed
+    artifact fails the round — 2x slower or half the MFU, binding on
+    TPU; a kernel key the previous round had must never silently
+    vanish."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_kernel_2x_slower_fails_on_tpu(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     kernel_conv0_gradw_us=12964.0)
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_us": 30000.0}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert any("KERNEL REGRESSION" in e
+                   and "kernel_conv0_gradw_us" in e
+                   for e in diag["errors"])
+
+    def test_mfu_halved_fails_on_tpu(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     kernel_conv0_gradw_mfu=0.107)
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_mfu": 0.04}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert any("KERNEL REGRESSION" in e and "mfu" in e
+                   for e in diag["errors"])
+
+    def test_regression_is_advisory_on_cpu_fallback(self, tmp_path):
+        artifact = {"metric": "m", "platform": "cpu",
+                    "kernel_vtrace_associative_us": 5.0}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        diag = {"errors": [], "platform": "cpu",
+                "kernel_vtrace_associative_us": 50.0}
+        bench.kernel_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert any("KERNEL REGRESSION" in w for w in diag["warnings"])
+
+    def test_healthy_kernels_are_silent(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, kernel_conv0_gradw_us=12964.0,
+            kernel_conv0_gradw_mfu=0.107)
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_us": 11000.0,
+                "kernel_conv0_gradw_mfu": 0.12}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == [] and "warnings" not in diag
+        assert diag["kernel_regression_keys"] == 2
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     kernel_lstm_grad_pallas_us=183.6)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert any("KERNEL REGRESSION" in e and "missing" in e
+                   for e in diag["errors"])
+
+    def test_note_keys_are_ignored(self, tmp_path):
+        """kernel_*_us_note string annotations must not be compared."""
+        bench_dir = self._write_prev(
+            tmp_path, kernel_vtrace_associative_us=2.8,
+            kernel_vtrace_associative_us_note="below timer resolution")
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_vtrace_associative_us": 2.9}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     kernel_conv0_gradw_us=12964.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.kernel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_runs_against_real_committed_artifacts(self):
+        diag = {"errors": [], "platform": "cpu"}
+        bench.kernel_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "KERNEL REGRESSION" in e]

@@ -161,8 +161,10 @@ class TestInGraphTrainer:
         trainer = self.make()
         state, carry = trainer.init(jax.random.key(0))
         rng = jax.random.key(1)
+        # _rollout takes the bare RolloutCarry; the telemetry half of
+        # the TrainCarry rides only the fused step.
         traj1, carry2 = jax.jit(trainer._rollout)(
-            state.params, carry, rng)
+            state.params, carry.rollout, rng)
         traj2, _ = jax.jit(trainer._rollout)(
             state.params, carry2, jax.random.key(2))
         np.testing.assert_array_equal(

@@ -1,0 +1,409 @@
+"""Device telemetry (obs/device_telemetry.py): instruments that live
+inside jitted programs.
+
+Covers the spec ops' numerics (vs numpy), accumulation across
+scan/jit/donation, the publisher's registry folding, the fleet fold
+rules for devtel/kernel series, and THE acceptance property of the
+whole design: a telemetry-bearing learner update issues zero
+device→host materializations and zero host→device transfers — the only
+sync is the explicit log-interval fetch.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.obs import MetricsRegistry, render_prometheus
+from scalable_agent_tpu.obs.aggregate import (
+    aggregate_prometheus,
+    parse_prometheus,
+)
+from scalable_agent_tpu.obs.device_telemetry import (
+    DeviceTelemetry,
+    TelemetryPublisher,
+    merge_init,
+)
+
+
+def make_spec():
+    return (
+        DeviceTelemetry("test")
+        .counter("events")
+        .gauge("level")
+        .histogram("value", (0.0, 1.0, 2.5, 10.0))
+    )
+
+
+class TestSpecOps:
+    def test_counter_gauge_roundtrip(self):
+        spec = make_spec()
+        tel = spec.init()
+        tel = spec.inc(tel, "events")
+        tel = spec.inc(tel, "events", 2.5)
+        tel = spec.set(tel, "level", 7.0)
+        tel = spec.set(tel, "level", 3.0)
+        fetched = spec.fetch(tel)
+        assert spec.value(fetched, "events") == pytest.approx(3.5)
+        assert spec.value(fetched, "level") == pytest.approx(3.0)
+
+    def test_histogram_buckets_are_right_closed(self):
+        """Buckets follow the published ``le_<edge>`` (<=) labels —
+        prometheus ``le`` semantics: a value exactly equal to an edge
+        counts in THAT edge's bucket, not the one above (numpy's
+        half-open convention would contradict the metric names)."""
+        spec = make_spec()
+        tel = spec.init()
+        values = np.asarray(
+            [-5.0, 0.0, 0.5, 1.0, 2.0, 2.5, 3.0, 100.0], np.float32)
+        tel = spec.observe(tel, "value", values)
+        hist = spec.value(spec.fetch(tel), "value")
+        edges = np.asarray(spec.histograms()["value"])
+        idx = np.searchsorted(edges, values, side="left")
+        want = np.bincount(idx, minlength=len(edges) + 1)
+        np.testing.assert_allclose(hist["buckets"], want)
+        # The edge values themselves land in their own le buckets.
+        assert want[0] == 2.0   # -5.0 and the 0.0 edge -> le_0
+        assert hist["count"] == len(values)
+        assert hist["sum"] == pytest.approx(float(values.sum()))
+        assert hist["mean"] == pytest.approx(float(values.mean()))
+
+    def test_observe_mask_and_shape(self):
+        spec = make_spec()
+        tel = spec.init()
+        values = np.arange(12, dtype=np.float32).reshape(3, 4)
+        mask = values % 2 == 0
+        tel = spec.observe(tel, "value", values, where=mask)
+        hist = spec.value(spec.fetch(tel), "value")
+        assert hist["count"] == mask.sum()
+        assert hist["sum"] == pytest.approx(float(values[mask].sum()))
+
+    def test_masked_nonfinite_cannot_poison_the_sum(self):
+        """A masked-out NaN/Inf must be SELECTED out of the cumulative
+        ":sum" buffer, eagerly and under jit — NaN * 0.0 = NaN, so a
+        multiply-by-mask implementation would poison every later fetch
+        of the run (the learner masks guard-absorbed non-finite grad
+        norms exactly this way)."""
+        import jax
+
+        spec = make_spec()
+        values = np.asarray([1.0, np.nan, np.inf], np.float32)
+        mask = np.asarray([True, False, False])
+        eager = lambda t, v, w: spec.observe(t, "value", v, where=w)
+        for observe in (eager, jax.jit(eager)):
+            tel = spec.init()
+            tel = observe(tel, values, mask)
+            hist = spec.value(spec.fetch(tel), "value")
+            assert hist["count"] == 1.0
+            assert hist["sum"] == pytest.approx(1.0)
+            assert np.isfinite(hist["buckets"]).all()
+
+    def test_unknown_names_raise(self):
+        spec = make_spec()
+        tel = spec.init()
+        with pytest.raises(KeyError):
+            spec.inc(tel, "nope")
+        with pytest.raises(KeyError):
+            spec.set(tel, "nope", 1.0)
+        with pytest.raises(KeyError):
+            spec.observe(tel, "nope", np.zeros(3))
+
+    def test_bad_edges_raise(self):
+        with pytest.raises(ValueError):
+            DeviceTelemetry("x").histogram("h", (1.0, 1.0))
+        with pytest.raises(ValueError):
+            DeviceTelemetry("x").histogram("h", (2.0, 1.0))
+        with pytest.raises(ValueError):
+            DeviceTelemetry("x").histogram("h", ())
+
+    def test_accumulates_under_jit_scan_and_donation(self):
+        """The production shape: the telemetry pytree is DONATED into a
+        jitted step whose body accumulates per scan iteration; the host
+        rebinds the returned buffers and fetches once at the end."""
+        spec = make_spec()
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(tel, values):
+            def body(tel, v):
+                tel = spec.inc(tel, "events")
+                tel = spec.observe(tel, "value", v)
+                return tel, ()
+
+            tel, _ = jax.lax.scan(body, tel, values)
+            tel = spec.set(tel, "level", values.sum())
+            return tel
+
+        tel = spec.init()
+        values = jnp.arange(20, dtype=jnp.float32).reshape(4, 5)
+        for _ in range(3):
+            tel = step(tel, values)
+        fetched = spec.fetch(tel)
+        assert spec.value(fetched, "events") == 3 * 4  # scan steps
+        hist = spec.value(fetched, "value")
+        assert hist["count"] == 3 * 20
+        assert hist["mean"] == pytest.approx(float(values.mean()))
+
+    def test_merge_init_keeps_namespaces_disjoint(self):
+        a = DeviceTelemetry("a").counter("n")
+        b = DeviceTelemetry("b").counter("n")
+        tel = merge_init([a, b])
+        tel = a.inc(tel, "n")
+        tel = a.inc(tel, "n")
+        tel = b.inc(tel, "n", 5.0)
+        assert a.value(a.fetch(tel), "n") == 2.0
+        assert b.value(b.fetch(tel), "n") == 5.0
+        # A's ops must pass B's leaves through untouched.
+        assert set(tel) == set(merge_init([a, b]))
+        with pytest.raises(ValueError, match="collision"):
+            merge_init([a, a])
+
+
+class TestPublisher:
+    def test_counters_delta_gauges_current(self):
+        spec = make_spec()
+        registry = MetricsRegistry()
+        publisher = TelemetryPublisher(spec, registry=registry)
+        tel = spec.init()
+        tel = spec.inc(tel, "events", 3.0)
+        tel = spec.set(tel, "level", 2.0)
+        tel = spec.observe(tel, "value", np.asarray([0.5, 3.0]))
+        publisher.publish(spec.fetch(tel))
+        # Re-publishing the same snapshot must not double-count the
+        # counter (delta tracking), while gauges just re-assert.
+        publisher.publish(spec.fetch(tel))
+        snap = registry.snapshot()
+        assert snap["devtel/test/events_total"] == 3.0
+        assert snap["devtel/test/events"] == 3.0
+        assert snap["devtel/test/level"] == 2.0
+        assert snap["devtel/test/value/count"] == 2.0
+        assert snap["devtel/test/value/mean"] == pytest.approx(1.75)
+        # Bucket counters: 0.5 lands in (0, 1], 3.0 in (2.5, 10].
+        assert snap["devtel/test/value/bucket/le_1_total"] == 1.0
+        assert snap["devtel/test/value/bucket/le_10_total"] == 1.0
+        # More observations later: counter advances by the delta.
+        tel = spec.inc(tel, "events", 2.0)
+        publisher.publish(spec.fetch(tel))
+        assert registry.snapshot()["devtel/test/events_total"] == 5.0
+
+    def test_renders_to_prometheus(self):
+        spec = make_spec()
+        registry = MetricsRegistry()
+        publisher = TelemetryPublisher(spec, registry=registry)
+        tel = spec.inc(spec.init(), "events")
+        publisher.publish(spec.fetch(tel))
+        text = render_prometheus(registry)
+        assert "impala_devtel_test_events_total 1.0" in text
+        assert "# TYPE impala_devtel_test_events_total counter" in text
+        assert "impala_devtel_test_level 0.0" in text
+
+
+class TestFleetFolds:
+    """Satellite: obs/aggregate.py folds devtel/kernel series fleet-wide
+    — devtel counters SUM, devtel gauges MAX, every kernel series MAX
+    (the busiest process's reading keeps the named verdict)."""
+
+    def _fold_value(self, folded, metric):
+        families = parse_prometheus(folded)
+        for fam, data in families.items():
+            for (name, labels), value in data["series"].items():
+                if name == metric and ("fold", ) and dict(labels).get(
+                        "fold"):
+                    return value, dict(labels)["fold"]
+        raise AssertionError(f"no fleet series for {metric}")
+
+    def test_devtel_counter_sums_gauge_maxes(self):
+        p0 = ("# TYPE impala_devtel_env_episodes_total counter\n"
+              "impala_devtel_env_episodes_total 10.0\n"
+              "# TYPE impala_devtel_env_episode_return_mean gauge\n"
+              "impala_devtel_env_episode_return_mean 2.0\n")
+        p1 = ("# TYPE impala_devtel_env_episodes_total counter\n"
+              "impala_devtel_env_episodes_total 32.0\n"
+              "# TYPE impala_devtel_env_episode_return_mean gauge\n"
+              "impala_devtel_env_episode_return_mean 3.5\n")
+        folded = aggregate_prometheus({"0": p0, "1": p1})
+        value, fold = self._fold_value(
+            folded, "impala_devtel_env_episodes_total")
+        assert (value, fold) == (42.0, "sum")
+        value, fold = self._fold_value(
+            folded, "impala_devtel_env_episode_return_mean")
+        assert (value, fold) == (3.5, "max")
+
+    def test_kernel_series_take_max(self):
+        p0 = ("# TYPE impala_kernel_conv0_gradw_mfu gauge\n"
+              "impala_kernel_conv0_gradw_mfu 0.107\n"
+              "# TYPE impala_kernel_worst_mfu gauge\n"
+              "impala_kernel_worst_mfu 0.107\n"
+              "# TYPE impala_kernel_dominant_time_share gauge\n"
+              "impala_kernel_dominant_time_share 0.4\n")
+        p1 = ("# TYPE impala_kernel_conv0_gradw_mfu gauge\n"
+              "impala_kernel_conv0_gradw_mfu 0.09\n"
+              "# TYPE impala_kernel_worst_mfu gauge\n"
+              "impala_kernel_worst_mfu 0.09\n"
+              "# TYPE impala_kernel_dominant_time_share gauge\n"
+              "impala_kernel_dominant_time_share 0.6\n")
+        folded = aggregate_prometheus({"0": p0, "1": p1})
+        for metric, want in (
+                ("impala_kernel_conv0_gradw_mfu", 0.107),
+                ("impala_kernel_worst_mfu", 0.107),
+                ("impala_kernel_dominant_time_share", 0.6)):
+            value, fold = self._fold_value(folded, metric)
+            assert fold == "max"
+            assert value == pytest.approx(want)
+
+
+# ---------------------------------------------------------------------------
+# The learner integration + the zero-host-sync acceptance proof.
+# ---------------------------------------------------------------------------
+
+
+def _small_learner():
+    from __graft_entry__ import _example_trajectory
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+
+    T, B = 4, 2
+    agent = ImpalaAgent(num_actions=4)
+    mesh = make_mesh(MeshSpec(data=1, model=1),
+                     devices=jax.devices()[:1])
+    learner = Learner(agent, LearnerHyperparams(
+        total_environment_frames=1e6), mesh, frames_per_update=T * B)
+    traj_host = _example_trajectory(T, B, 16, 16, 4)
+    state = learner.init(jax.random.key(0), traj_host)
+    traj = learner.put_trajectory(traj_host)
+    return learner, state, traj
+
+
+@pytest.fixture(scope="module")
+def learner_setup():
+    # Mutable box: the update DONATES the state buffers, so tests must
+    # write the new state back for the next test to use.
+    learner, state, traj = _small_learner()
+    return {"learner": learner, "state": state, "traj": traj}
+
+
+class TestLearnerTelemetry:
+    def test_update_accumulates_device_instruments(self, learner_setup):
+        learner, traj = learner_setup["learner"], learner_setup["traj"]
+        state = learner_setup["state"]
+        before = learner.fetch_device_telemetry()
+        updates_before = learner.devtel_spec.value(before, "updates")
+        for _ in range(3):
+            state, metrics = learner.update(state, traj)
+        learner_setup["state"] = state
+        fetched = learner.publish_device_telemetry()
+        spec = learner.devtel_spec
+        assert (spec.value(fetched, "updates")
+                == updates_before + 3)
+        assert spec.value(fetched, "skipped") == 0.0
+        hist = spec.value(fetched, "grad_norm")
+        assert hist["count"] >= 3
+        # The loss gauge mirrors the last update's loss exactly.
+        assert spec.value(fetched, "loss") == pytest.approx(
+            float(np.asarray(metrics["total_loss"])), rel=1e-6)
+        # Published into the registry under devtel/learner/*.
+        from scalable_agent_tpu.obs import get_registry
+
+        snap = get_registry().snapshot()
+        assert snap["devtel/learner/updates"] == spec.value(
+            fetched, "updates")
+        assert "devtel/learner/grad_norm/mean" in snap
+
+    def test_update_issues_no_host_syncs(self, learner_setup,
+                                         monkeypatch):
+        """THE acceptance property (ISSUE 12): telemetry-bearing
+        updates issue no device→host transfer outside the log-interval
+        fetch.  Transfer-count instrumentation: every Python-level D2H
+        materialization path on jax arrays (``_value``, ``__array__``,
+        explicit ``jax.device_get``) is spied, and the updates run
+        under ``jax.transfer_guard("disallow")``, which hard-errors any
+        host→device transfer.  (On the CPU backend numpy's buffer
+        protocol can bypass the Python spies for zero-copy reads; the
+        spied paths are exactly the idioms instrumented runtime code
+        could accidentally introduce — float()/np.asarray()/item().)"""
+        import jaxlib.xla_extension as xe
+
+        learner, traj = learner_setup["learner"], learner_setup["traj"]
+        state = learner_setup["state"]
+        # Warm the compile (constants may transfer during lowering).
+        state, _ = learner.update(state, traj)
+
+        calls = []
+        cls = type(jnp.zeros(()))
+        assert cls is xe.ArrayImpl
+        orig_value = cls.__dict__["_value"]
+        orig_array = cls.__array__
+
+        def spy_value(self):
+            calls.append("_value")
+            return orig_value.fget(self)
+
+        def spy_array(self, *args, **kwargs):
+            calls.append("__array__")
+            return orig_array(self, *args, **kwargs)
+
+        monkeypatch.setattr(cls, "_value", property(spy_value))
+        monkeypatch.setattr(cls, "__array__", spy_array)
+
+        with jax.transfer_guard("disallow"):
+            for _ in range(4):
+                state, metrics = learner.update(state, traj)
+        assert calls == [], (
+            f"telemetry-bearing updates materialized device values on "
+            f"the host: {calls}")
+        # The explicit fetch IS a sync — and the only one.
+        learner_setup["state"] = state
+        fetched = learner.fetch_device_telemetry()
+        assert calls, "fetch should materialize on the host"
+        assert learner.devtel_spec.value(fetched, "updates") >= 4
+
+    def test_disabled_telemetry_is_inert(self):
+        from __graft_entry__ import _example_trajectory
+        from scalable_agent_tpu.models import ImpalaAgent
+        from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+        from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+
+        agent = ImpalaAgent(num_actions=4)
+        mesh = make_mesh(MeshSpec(data=1, model=1),
+                         devices=jax.devices()[:1])
+        learner = Learner(agent, LearnerHyperparams(), mesh,
+                          frames_per_update=8, device_telemetry=False)
+        traj = _example_trajectory(4, 2, 16, 16, 4)
+        state = learner.init(jax.random.key(0), traj)
+        state, metrics = learner.update(state, traj)
+        assert np.isfinite(float(np.asarray(metrics["total_loss"])))
+        assert learner.fetch_device_telemetry() is None
+        assert learner.publish_device_telemetry() is None
+
+    def test_nonfinite_batch_counts_as_skipped(self):
+        from __graft_entry__ import _example_trajectory
+        from scalable_agent_tpu.models import ImpalaAgent
+        from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+        from scalable_agent_tpu.runtime import Learner, LearnerHyperparams
+
+        agent = ImpalaAgent(num_actions=4)
+        mesh = make_mesh(MeshSpec(data=1, model=1),
+                         devices=jax.devices()[:1])
+        learner = Learner(agent, LearnerHyperparams(), mesh,
+                          frames_per_update=8)
+        traj = _example_trajectory(4, 2, 16, 16, 4)
+        state = learner.init(jax.random.key(0), traj)
+        poisoned = traj._replace(
+            env_outputs=traj.env_outputs._replace(
+                reward=traj.env_outputs.reward * np.float32("nan")))
+        state, _ = learner.update(state, poisoned)
+        state, _ = learner.update(state, traj)
+        fetched = learner.fetch_device_telemetry()
+        spec = learner.devtel_spec
+        assert spec.value(fetched, "updates") == 2.0
+        assert spec.value(fetched, "skipped") == 1.0
+        # The NaN gradient the guard absorbed must NOT have reached the
+        # grad_norm histogram: its ":sum" buffer is cumulative, so one
+        # unmasked non-finite observation would poison every later
+        # fetch of the run.
+        hist = spec.value(fetched, "grad_norm")
+        assert hist["count"] == 1.0  # only the healthy update observed
+        assert np.isfinite(hist["sum"])
+        assert np.isfinite(hist["buckets"]).all()

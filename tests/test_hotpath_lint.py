@@ -1,0 +1,149 @@
+"""Static guard: no host callbacks inside the jitted hot path.
+
+"Telemetry never syncs the host" (obs/device_telemetry.py) has a
+dynamic proof — tests/test_device_telemetry.py counts transfers around
+a telemetry-bearing update — and this is its static complement: the
+modules that build jitted programs (``scalable_agent_tpu/runtime/`` and
+``scalable_agent_tpu/models/``) must not call the jax escape hatches
+that smuggle a host round-trip into a compiled program:
+
+- ``jax.debug.print`` / ``jax.debug.callback`` — per-executed-trace
+  host callbacks,
+- ``jax.pure_callback`` / ``jax.experimental.io_callback`` /
+  ``host_callback`` — host calls inside the program.
+
+Any of these inside the update/rollout/fused-step path would reopen
+the per-step host↔device chatter the whole architecture exists to
+close (and on the fused flywheel there is no "slow path" to hide them
+on).  The lint walks the ASTs (the ``test_collective_lint.py`` /
+``test_ledger_lint.py`` pattern); a justified exception goes in
+``ALLOWLIST`` with the module-relative path and callee name — and a
+stale entry FAILS, so the list can only shrink.
+"""
+
+import ast
+import os
+
+import scalable_agent_tpu
+
+PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
+
+# Directories whose modules assemble jitted programs.
+HOT_DIRS = ("runtime", "models")
+
+# Callee names that are host callbacks regardless of how they are
+# reached (bare name, jax.pure_callback, jax.experimental.io_callback,
+# from-imports, ...).
+FORBIDDEN_NAMES = frozenset((
+    "io_callback",
+    "pure_callback",
+    "host_callback",
+    "call_tbx",  # host_callback's legacy entry points
+))
+
+# (relative_path, callee) -> justification.  Empty on purpose: nothing
+# in the hot path needs a host callback today.  A future entry must
+# say WHY the callback cannot ride device telemetry instead.
+ALLOWLIST = {}
+
+
+def _callee_chain(func) -> str:
+    """Dotted name of a call target, best effort: ``jax.debug.print``
+    -> "jax.debug.print", bare ``io_callback`` -> "io_callback"."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_forbidden(chain: str) -> bool:
+    if not chain:
+        return False
+    leaf = chain.split(".")[-1]
+    if leaf in FORBIDDEN_NAMES:
+        return True
+    # jax.debug.print / jax.debug.callback (but NOT logging-style
+    # .print on arbitrary objects without the debug parent, and not
+    # the flight recorder's own .callback attributes).
+    if leaf in ("print", "callback"):
+        pieces = chain.split(".")
+        return len(pieces) >= 2 and pieces[-2] == "debug"
+    return False
+
+
+def _scan_module(path: str):
+    tree = ast.parse(open(path).read(), filename=path)
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _callee_chain(node.func)
+            if _is_forbidden(chain):
+                hits.append((chain, node.lineno))
+        # from jax.experimental import io_callback  (importing it into
+        # a hot module is the lint's business even before it is called)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names or ():
+                if alias.name in FORBIDDEN_NAMES:
+                    hits.append((alias.name, node.lineno))
+    return hits
+
+
+def _hot_modules():
+    for sub in HOT_DIRS:
+        base = os.path.join(PKG_DIR, sub)
+        for dirpath, _, filenames in os.walk(base):
+            for filename in sorted(filenames):
+                if filename.endswith(".py"):
+                    yield os.path.join(dirpath, filename)
+
+
+def test_no_host_callbacks_in_jitted_hot_path():
+    violations = []
+    used_allowlist = set()
+    for path in _hot_modules():
+        rel = os.path.relpath(path, PKG_DIR)
+        for chain, lineno in _scan_module(path):
+            key = (rel, chain)
+            if key in ALLOWLIST:
+                used_allowlist.add(key)
+                continue
+            violations.append(f"{rel}:{lineno} calls {chain}")
+    assert not violations, (
+        "host callbacks inside the jitted hot path (device telemetry "
+        "exists so these are never needed — obs/device_telemetry.py):\n"
+        + "\n".join(violations))
+    stale = set(ALLOWLIST) - used_allowlist
+    assert not stale, (
+        f"stale hot-path allowlist entries (the call is gone — delete "
+        f"them): {sorted(stale)}")
+
+
+def test_lint_actually_detects_violations(tmp_path):
+    """The lint must FAIL on code using the forbidden callbacks — a
+    matcher that never matches would pass the repo vacuously."""
+    sample = tmp_path / "bad.py"
+    sample.write_text(
+        "import jax\n"
+        "from jax.experimental import io_callback\n"
+        "def f(x):\n"
+        "    jax.debug.print('x={}', x)\n"
+        "    jax.pure_callback(lambda v: v, x, x)\n"
+        "    return x\n")
+    hits = _scan_module(str(sample))
+    chains = {chain for chain, _ in hits}
+    assert "jax.debug.print" in chains
+    assert "jax.pure_callback" in chains
+    assert "io_callback" in chains  # the from-import itself
+
+
+def test_hot_dirs_exist_and_are_scanned():
+    modules = list(_hot_modules())
+    names = {os.path.relpath(m, PKG_DIR) for m in modules}
+    assert any(n.startswith("runtime") for n in names)
+    assert any(n.startswith("models") for n in names)
+    assert os.path.join("runtime", "learner.py") in {
+        os.path.relpath(m, PKG_DIR) for m in modules}

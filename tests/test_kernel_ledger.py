@@ -1,0 +1,301 @@
+"""Kernel roofline ledger (obs/kernels.py): profiler trace × HLO cost
+model → kernels.json → report.
+
+The acceptance loop on the CPU rig: a traced run's per-kernel FLOPs sum
+to the ledger-MFU numerator (XLA's cost-analysis total over the shared
+``PEAK_FLOPS`` denominator), ``kernels.json`` is written by a traced
+driver run, and ``python -m scalable_agent_tpu.obs.report --json``
+names the dominant kernel — plus the report's bench-artifact section
+naming ``conv0_gradw`` from the committed r04/r05 readings
+automatically.
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.obs import kernels as kernels_lib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _compiled_conv_dot():
+    def f(x, w, m):
+        y = jax.lax.conv_general_dilated(
+            x, w, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return (jnp.tanh(y).reshape(x.shape[0], -1)[:, :64] @ m).sum()
+
+    x = jnp.ones((8, 32, 32, 3))
+    w = jnp.ones((5, 5, 3, 16))
+    m = jnp.ones((64, 32))
+    compiled = jax.jit(f).lower(x, w, m).compile()
+    return compiled, (x, w, m)
+
+
+class TestHloCostModel:
+    def test_dot_flops_exact(self):
+        hlo = """
+ENTRY %main (a: f32[128,64], b: f32[64,32]) -> f32[128,32] {
+  %a = f32[128,64]{1,0} parameter(0)
+  %b = f32[64,32]{1,0} parameter(1)
+  ROOT %dot.1 = f32[128,32]{1,0} dot(f32[128,64]{1,0} %a, f32[64,32]{1,0} %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+        costs = kernels_lib.parse_hlo_kernel_costs(hlo)
+        assert costs["dot.1"]["flops_est"] == 2 * 128 * 32 * 64
+        # bytes: both operands + the result, f32.
+        assert costs["dot.1"]["bytes"] == 4 * (128 * 64 + 64 * 32
+                                               + 128 * 32)
+        assert costs["a"]["flops_est"] == 0.0  # parameters are free
+
+    def test_fusion_sums_called_computation(self):
+        hlo = """
+%fused (p: f32[1024]) -> f32[1024] {
+  %p = f32[1024]{0} parameter(0)
+  %t = f32[1024]{0} tanh(f32[1024]{0} %p)
+  ROOT %m = f32[1024]{0} multiply(f32[1024]{0} %t, f32[1024]{0} %t)
+}
+ENTRY %main (x: f32[1024]) -> f32[1024] {
+  %x = f32[1024]{0} parameter(0)
+  ROOT %my_fusion = f32[1024]{0} fusion(f32[1024]{0} %x), kind=kLoop, calls=%fused
+}
+"""
+        costs = kernels_lib.parse_hlo_kernel_costs(hlo)
+        assert costs["my_fusion"]["flops_est"] == 2 * 1024
+        # Fusion bytes are the kernel-boundary traffic, not the
+        # internal temporaries.
+        assert costs["my_fusion"]["bytes"] == 4 * 2 * 1024
+
+    def test_real_compiled_module_parses_and_names_ops(self):
+        compiled, _ = _compiled_conv_dot()
+        costs = kernels_lib.parse_hlo_kernel_costs(compiled.as_text())
+        conv = [n for n, c in costs.items() if c["op"] == "convolution"]
+        dots = [n for n, c in costs.items() if c["op"] == "dot"]
+        assert conv and dots
+        # Conv flops: 2 * out_elems * kernel_taps_per_output.
+        (conv_name, ) = conv
+        assert costs[conv_name]["flops_est"] == pytest.approx(
+            2 * (8 * 16 * 16 * 16) * (5 * 5 * 3))
+
+
+class TestTraceJoin:
+    def test_harvest_roundtrip(self, tmp_path, monkeypatch):
+        """Profile a compiled program, harvest, and verify the
+        acceptance identity: per-kernel FLOPs sum to the MFU numerator
+        handed in (the XLA cost-analysis total)."""
+        compiled, args = _compiled_conv_dot()
+        compiled(*args)  # warm
+        profile_dir = str(tmp_path / "prof")
+        executions = 4
+        with jax.profiler.trace(profile_dir):
+            for _ in range(executions):
+                out = compiled(*args)
+            jax.block_until_ready(out)
+
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops_total = float(cost["flops"])
+        from scalable_agent_tpu.obs import MetricsRegistry
+
+        registry = MetricsRegistry()
+        table = kernels_lib.harvest(
+            profile_dir, compiled.as_text(), flops_total,
+            peak_flops=1e12, logdir=str(tmp_path / "run"),
+            registry=registry, executions=executions)
+        assert table is not None and table["kernels"], table
+
+        # THE identity: per-kernel FLOPs sum to the ledger-MFU
+        # numerator (normalized attribution of XLA's own total).
+        assert sum(row["flops"] for row in table["kernels"]) \
+            == pytest.approx(flops_total, rel=1e-6)
+        assert table["flops_total"] == flops_total
+
+        # kernels.json persisted and re-readable.
+        path = os.path.join(str(tmp_path / "run"), "kernels.json")
+        assert os.path.exists(path)
+        persisted = json.load(open(path))
+        assert persisted["dominant_kernel"] == table["dominant_kernel"]
+
+        # Roofline MFU is populated against the synthetic peak and the
+        # rows aggregate real calls from the window.
+        dominant = table["kernels"][0]
+        assert dominant["calls"] >= executions
+        assert 0 < dominant["mfu"] <= 1.0 or dominant["mfu"] >= 0
+
+        # Registry gauges for the verdict + the stall hand-off.
+        snap = registry.snapshot()
+        assert "kernel/matched_time_frac" in snap
+        assert kernels_lib.last_dominant(registry)[0] \
+            == table["dominant_kernel"]
+        assert kernels_lib.last_dominant(MetricsRegistry()) is None
+
+    def test_harvest_without_traces_returns_none(self, tmp_path):
+        assert kernels_lib.harvest(
+            str(tmp_path / "nothing"), "", 0.0, None, None) is None
+
+    def test_trace_events_filter_by_hlo_module(self, tmp_path):
+        """XLA instruction names are unique only per module: an event
+        annotated with ANOTHER module's name (a concurrently-running
+        actor_step, say) must not be joined to the update's same-named
+        instruction; unannotated events pass through."""
+        path = str(tmp_path / "x.trace.json")
+        events = [
+            {"ph": "X", "name": "fusion.1", "dur": 10.0,
+             "args": {"hlo_module": "jit_update"}},
+            {"ph": "X", "name": "fusion.1", "dur": 999.0,
+             "args": {"hlo_module": "jit_actor_step"}},
+            {"ph": "X", "name": "fusion.2", "dur": 5.0},  # unannotated
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        out = kernels_lib.load_trace_kernel_events(
+            path, module="jit_update")
+        assert out["fusion.1"] == {"time_us": 10.0, "calls": 1.0}
+        assert out["fusion.2"] == {"time_us": 5.0, "calls": 1.0}
+        # No filter: everything aggregates by name (legacy behavior).
+        both = kernels_lib.load_trace_kernel_events(path)
+        assert both["fusion.1"]["time_us"] == pytest.approx(1009.0)
+        # The module name harvest() derives comes off the HLO header.
+        assert kernels_lib.hlo_module_name(
+            "HloModule jit_update, is_scheduled=true\n") == "jit_update"
+
+
+class TestReportKernels:
+    def _write_minimal_prom(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir, "metrics.prom"), "w") as f:
+            f.write("# TYPE impala_ledger_mfu gauge\n"
+                    "impala_ledger_mfu 0.1\n")
+
+    def test_report_json_names_dominant_kernel(self, tmp_path, capsys):
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_minimal_prom(logdir)
+        kernels_lib.write_kernels_json(logdir, {
+            "schema_version": 1,
+            "flops_total": 1e9,
+            "matched_time_frac": 0.9,
+            "kernels": [
+                {"name": "loss_grad_fusion", "time_us": 900.0,
+                 "time_share": 0.9, "calls": 5, "flops": 9e8,
+                 "intensity": 12.0, "mfu": 0.11},
+                {"name": "optimizer_fusion", "time_us": 100.0,
+                 "time_share": 0.1, "calls": 5, "flops": 1e8,
+                 "intensity": 3.0, "mfu": 0.55},
+            ],
+            "worst_kernel": "loss_grad_fusion",
+            "worst_kernel_mfu": 0.11,
+            "dominant_kernel": "loss_grad_fusion",
+            "dominant_time_share": 0.9,
+        })
+        assert report.main(["--json", logdir]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["kernels"]["dominant"] == "loss_grad_fusion"
+        assert payload["kernels"]["worst"] == "loss_grad_fusion"
+        assert payload["kernels"]["rows"][0]["mfu"] == 0.11
+
+        # The text rendering carries the same verdict.
+        assert report.main([logdir]) == 0
+        out = capsys.readouterr().out
+        assert "worst kernels (this run's profile window)" in out
+        assert "loss_grad_fusion" in out
+        assert "worst kernel: loss_grad_fusion" in out
+
+    def test_report_names_conv0_gradw_from_bench_artifact(
+            self, tmp_path, capsys):
+        """The committed BENCH_r05 artifact carries the hand-measured
+        kernel rooflines; the report must surface them automatically
+        and name conv0_gradw (0.107 MFU) as the worst kernel."""
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_minimal_prom(logdir)
+        payload = report.build_report(logdir, bench_dir=REPO_ROOT)
+        bench_kernels = payload["bench_kernels"]
+        assert bench_kernels is not None
+        assert bench_kernels["worst"] == "conv0_gradw"
+        assert bench_kernels["worst_mfu"] == pytest.approx(0.107)
+        names = {row["name"] for row in bench_kernels["rows"]}
+        assert "conv0_gradw" in names
+
+        assert report.main([logdir, "--bench_dir", REPO_ROOT]) == 0
+        out = capsys.readouterr().out
+        assert "worst kernels (newest bench artifact)" in out
+        assert "worst kernel: conv0_gradw" in out
+
+        assert report.main(["--json", logdir,
+                            "--bench_dir", REPO_ROOT]) == 0
+        machine = json.loads(capsys.readouterr().out)
+        assert machine["bench_kernels"]["worst"] == "conv0_gradw"
+
+    def test_bench_kernels_absent_outside_a_checkout(self, tmp_path):
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_minimal_prom(logdir)
+        payload = report.build_report(
+            logdir, bench_dir=str(tmp_path / "empty"))
+        assert payload["bench_kernels"] is None
+
+
+def test_traced_driver_run_writes_kernel_ledger(tmp_path, monkeypatch,
+                                                capsys):
+    """Tier-1 acceptance: a --profile_dir driver run on the CPU rig
+    writes kernels.json, publishes kernel/* gauges into the prom
+    snapshot, and the report CLI names the dominant kernel from it."""
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.driver import train as run_train
+    from scalable_agent_tpu.obs import report
+
+    monkeypatch.setenv("SCALABLE_AGENT_LEDGER_MFU_PEAK", "1e12")
+    config = Config(
+        mode="train",
+        logdir=str(tmp_path / "run"),
+        level_name="fake_small",
+        num_actors=4,
+        batch_size=2,
+        unroll_length=4,
+        num_action_repeats=1,
+        total_environment_frames=24,  # 3 updates of 8 frames
+        height=16,
+        width=16,
+        num_env_workers_per_group=2,
+        compute_dtype="float32",
+        checkpoint_interval_s=1e9,
+        log_interval_s=0.0,
+        profile_dir=str(tmp_path / "profile"),
+        profile_start_update=1,
+        profile_num_updates=1,
+        seed=5,
+    )
+    metrics = run_train(config)
+    assert metrics["env_frames"] == 24
+
+    # The profile window left a device trace and the harvest joined it.
+    kernels_path = os.path.join(config.logdir, "kernels.json")
+    assert os.path.exists(kernels_path), glob.glob(
+        os.path.join(config.logdir, "*"))
+    table = json.load(open(kernels_path))
+    assert table["kernels"], table
+    assert table["dominant_kernel"]
+    assert table["flops_total"] > 0
+    assert sum(row["flops"] for row in table["kernels"]) \
+        == pytest.approx(table["flops_total"], rel=1e-6)
+
+    # kernel/* gauges rode the prom snapshot.
+    prom = open(os.path.join(config.logdir, "metrics.prom")).read()
+    assert "impala_kernel_matched_time_frac" in prom
+    assert "impala_kernel_dominant_time_share" in prom
+
+    # The report names the dominant kernel, machine-readably.
+    assert report.main(["--json", config.logdir]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["kernels"]["dominant"] == table["dominant_kernel"]

@@ -11,6 +11,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from scalable_agent_tpu.config import Config
 from scalable_agent_tpu.driver import train as run_train
@@ -108,3 +109,87 @@ def test_traced_driver_run_emits_trace_and_prometheus(tmp_path):
     assert any("total_loss" in r for r in rows)
     assert any("timing/update" in r for r in rows)
     assert any(any(k.startswith("obs/") for k in r) for r in rows)
+
+    # Device telemetry (obs/device_telemetry.py) rode the update in
+    # donated buffers and published at log cadence: the learner's
+    # devtel gauges carry THIS run's exact device-side counts.
+    values = _prom_values(text)
+    assert values["impala_devtel_learner_updates"] == 2.0
+    assert values["impala_devtel_learner_skipped"] == 0.0
+    assert values["impala_devtel_learner_grad_norm_count"] == 2.0
+
+
+def _prom_values(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        key, _, value = line.rpartition(" ")
+        try:
+            out[key] = float(value)
+        except ValueError:
+            pass
+    return out
+
+
+def test_ingraph_driver_run_publishes_device_telemetry(tmp_path):
+    """Tier-1 fused-backend obs smoke (ISSUE 12 satellite): the
+    in-graph trainer's donated telemetry pytree surfaces the on-device
+    env's episodes through the ordinary prom path, and the published
+    values match a host-replayed episode of the same level — the fused
+    megastep inherits a WORKING obs plane, not a dark one."""
+    from scalable_agent_tpu.envs import make_impala_stream
+
+    config = Config(
+        mode="train",
+        logdir=str(tmp_path / "run"),
+        level_name="fake_small",
+        train_backend="ingraph",
+        num_actors=4,
+        batch_size=4,
+        unroll_length=5,
+        num_action_repeats=2,
+        total_environment_frames=240,  # 6 updates of 40 frames
+        height=16,
+        width=16,
+        compute_dtype="float32",
+        checkpoint_interval_s=1e9,
+        log_interval_s=0.0,
+        seed=7,
+    )
+    run_train(config)
+    text = open(os.path.join(config.logdir, "metrics.prom")).read()
+    values = _prom_values(text)
+
+    # Host replay of ONE fake_small episode through the real host
+    # stream: the device telemetry's exact per-episode means must
+    # agree with it (the host/device env mirror contract).
+    stream = make_impala_stream("fake_small", seed=3,
+                                num_action_repeats=2)
+    try:
+        stream.initial()
+        replay_return = 0.0
+        replay_steps = 0
+        while True:
+            out = stream.step(0)
+            replay_return += float(out.reward)
+            replay_steps += 1
+            if bool(out.done):
+                break
+    finally:
+        stream.close()
+
+    # The learner's device instruments: one count per fused update.
+    assert values["impala_devtel_learner_updates"] == 6.0
+    assert values["impala_devtel_learner_skipped"] == 0.0
+    # The env instruments: every env finishes one episode per
+    # episode-length agent steps; all episodes completed on device are
+    # counted, and the EXACT means match the host replay.
+    assert values["impala_devtel_env_episodes"] >= 20.0
+    assert values["impala_devtel_env_episode_return_mean"] == \
+        pytest.approx(replay_return, rel=1e-6)
+    assert values["impala_devtel_env_episode_length_mean"] == \
+        pytest.approx(replay_steps, rel=1e-6)
+    # Counter series (fleet-foldable, monotonic) are present too.
+    assert "impala_devtel_env_episodes_total" in values
+    assert "impala_devtel_env_episode_return_bucket_le_2_total" in text
