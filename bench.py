@@ -27,6 +27,7 @@ even when every stage fails.
 
 import functools
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1668,6 +1669,233 @@ def bench_resilience(diag, budget_s=90.0):
     diag["resilience_secs"] = round(time.perf_counter() - t_start, 1)
 
 
+def _timed_sampled_updates(update, state, buf, iters):
+    """``_timed_updates`` with the batch drawn from the replay slab
+    each iteration — the real sampled-update path (gather + update),
+    synced by value-fetching the final loss."""
+    t0 = time.perf_counter()
+    metrics = None
+    for _ in range(iters):
+        state, metrics = update(state, buf.sample())
+    _fetch_scalar(metrics["total_loss"])
+    return (time.perf_counter() - t0) / iters, state, metrics
+
+
+def bench_replay(diag, budget_s=300.0):
+    """Replay stage (ISSUE 13): the device-resident slab's unit costs,
+    the sampled-update vs fresh-update throughput ratio, and the
+    loss-vs-replay-ratio curve — the algorithmic-regression guard
+    ROADMAP item 2 asks for before anyone trusts ``--replay_ratio`` as
+    a throughput dial.
+
+    Three measurements:
+
+    - **slab micro**: jitted insert / sample dispatch+execute us at the
+      learner batch (sync via the slab / sampled leaves);
+    - **sampled-update fps** vs fresh-update fps at B=32 (CPU fallback
+      shrinks the batch like the other learner stages): acceptance is
+      sampled >= 0.95x fresh — the gather must be noise, not a stage;
+    - **the curve**: the fused in-graph trainer on ``fake_bandit``
+      (known random floor 4.0, optimal 16.0 — bench_learning's level)
+      with ``--loss=impact`` at R in {0, 1, 2, 4}, same init key and
+      update count per arm; final return and loss per arm land in the
+      artifact, and ``replay_regression_guard`` fails the bench when
+      an R <= 2 arm diverges from the R=0 anchor."""
+    import jax
+    import numpy as np
+
+    from scalable_agent_tpu.runtime import DeviceReplayBuffer
+
+    t_start = time.perf_counter()
+    cpu = diag.get("platform") == "cpu"
+    batch = 8 if cpu else 32
+    diag["replay_batch"] = batch
+    sub = {"errors": diag["errors"]}
+
+    # -- slab micro + sampled-vs-fresh fps --------------------------------
+    learner, update, state, traj, _, frames_per_update = (
+        _bench_learner_setup(batch, sub))
+    buf = DeviceReplayBuffer(8, seed=0)
+    buf.insert(traj)   # compiles the insert program
+    buf.sample()       # compiles the sample program
+    n_micro = 20 if cpu else 100
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        buf.insert(traj)
+    jax.block_until_ready(
+        [leaf for leaf in buf._slabs if leaf is not None])
+    diag["replay_insert_us"] = round(
+        (time.perf_counter() - t0) / n_micro * 1e6, 1)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_micro):
+        out = buf.sample()
+    jax.block_until_ready(
+        [leaf for leaf in jax.tree_util.tree_leaves(out)
+         if leaf is not None])
+    diag["replay_sample_us"] = round(
+        (time.perf_counter() - t0) / n_micro * 1e6, 1)
+
+    once, state, _ = _timed_updates(update, state, traj, 1)
+    per_run_s = min(budget_s / 10.0, 15.0)
+    iters = max(3, min(100, int(per_run_s / max(once, 1e-4))))
+    diag["replay_fps_iters"] = iters
+    # Interleaved minima, like bench_resilience: scheduler jitter
+    # biases fresh and sampled the same way.
+    dts_fresh, dts_sampled = [], []
+    for _ in range(2):
+        dt, state, _ = _timed_updates(update, state, traj, iters)
+        dts_fresh.append(dt)
+        dt, state, _ = _timed_sampled_updates(update, state, buf, iters)
+        dts_sampled.append(dt)
+    dt_fresh, dt_sampled = min(dts_fresh), min(dts_sampled)
+    diag["replay_fresh_update_fps"] = round(
+        frames_per_update / dt_fresh, 1)
+    diag["replay_sampled_update_fps"] = round(
+        frames_per_update / dt_sampled, 1)
+    diag["replay_sampled_vs_fresh_fps"] = round(dt_fresh / dt_sampled, 3)
+    # One slab insert (per fresh batch) + one sample (per replayed
+    # update), amortized against the update stage they ride behind.
+    diag["replay_overhead_frac_on_update"] = round(
+        (diag["replay_insert_us"] + diag["replay_sample_us"])
+        / 1e6 / dt_fresh, 5)
+    del learner, update, state, traj, buf
+
+    # -- the loss-vs-replay-ratio curve -----------------------------------
+    from scalable_agent_tpu.envs.device import make_device_env
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import (
+        InGraphTrainer, Learner, LearnerHyperparams)
+
+    unroll_len, cbatch, arm_updates, chunk = 16, 16 if cpu else 32, 50, 25
+    env = make_device_env("fake_bandit")
+    agent = ImpalaAgent(num_actions=env.num_actions)
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    hp = LearnerHyperparams(
+        total_environment_frames=float(
+            arm_updates * unroll_len * cbatch),
+        learning_rate=0.002, entropy_cost=0.003)
+    impact_learner = Learner(agent, hp, mesh,
+                             frames_per_update=unroll_len * cbatch,
+                             loss="impact", target_update_interval=10)
+    # ONE trainer (one fused compile) reused across every arm: each arm
+    # re-inits from the same key, so the arms differ ONLY in R.
+    trainer = InGraphTrainer(agent, impact_learner, env, unroll_len,
+                             cbatch, seed=3, emit_trajectory=True)
+    curve = []
+    diag["replay_curve_updates"] = arm_updates
+    for ratio in (0, 1, 2, 4):
+        state, carry = trainer.init(jax.random.key(0))
+        rbuf = DeviceReplayBuffer(16, seed=0) if ratio else None
+        returns, metrics = [], None
+        for done in range(arm_updates):
+            # Episode stats ride the FRESH step's metrics only (the
+            # replayed update has no env interaction to report).
+            state, carry, fresh_metrics, fresh_traj = trainer.train_step(
+                state, carry, np.int32(done))
+            metrics = fresh_metrics
+            if rbuf is not None:
+                rbuf.insert(fresh_traj)
+                for _ in range(ratio):
+                    state, tel, metrics = trainer.replay_step(
+                        state, carry.telemetry, rbuf.sample())
+                    carry = carry._replace(telemetry=tel)
+            if (done + 1) % chunk == 0:
+                # Value-fetch sync (block_until_ready lies on the axon
+                # tunnel), and the chunk cadence bounds dispatch depth.
+                returns.append(round(float(np.asarray(
+                    fresh_metrics["episode_return"])), 2))
+        final_loss = float(np.asarray(metrics["total_loss"]))
+        curve.append([ratio, returns[-1] if returns else None,
+                      round(final_loss, 3)])
+        if time.perf_counter() - t_start > budget_s:
+            diag["errors"].append(
+                f"bench_replay hit its {budget_s:.0f}s budget after "
+                f"the R={ratio} arm")
+            break
+    # [[replay_ratio, final mean episode return, final loss]] — the
+    # R=0 row is the anchor replay_regression_guard compares against.
+    diag["replay_ratio_curve"] = curve
+    diag["replay_secs"] = round(time.perf_counter() - t_start, 1)
+
+
+# The replay slab's budget on the update stage (ISSUE 13 acceptance):
+# insert + sample dispatch must stay under 5%, and a sampled update
+# must retire at >= 0.95x the fresh-update rate at the learner batch.
+REPLAY_BUDGET_FRAC = 0.05
+REPLAY_SAMPLED_FPS_FLOOR = 0.95
+# An R <= 2 arm's final return below this fraction of the R=0 anchor is
+# an algorithmic regression (IMPACT's clip is SUPPOSED to make modest
+# replay ratios safe); R=4 divergence is advisory — the dial's far end
+# is tuning territory, not a contract.
+REPLAY_CURVE_FLOOR_FRAC = 0.7
+
+
+def replay_regression_guard(diag):
+    """ISSUE 13 acceptance: fail the bench when the replay slab costs
+    more than 5% of the update stage or a sampled update runs slower
+    than 0.95x a fresh one (binding on TPU, advisory on the CPU
+    fallback where compile/scheduler jitter exceeds the resolution),
+    or when the loss-vs-replay-ratio curve shows an R <= 2 arm
+    diverging from the R=0 anchor (binding EVERYWHERE — learning
+    dynamics, unlike timings, do not get a CPU excuse)."""
+    cpu = diag.get("platform") == "cpu"
+
+    def flag(message):
+        if cpu:
+            diag.setdefault("warnings", []).append(
+                message + " — CPU fallback: advisory")
+        else:
+            diag["errors"].append(message)
+
+    frac = diag.get("replay_overhead_frac_on_update")
+    if frac is not None and frac > REPLAY_BUDGET_FRAC:
+        flag(f"REPLAY: slab insert+sample overhead {frac:.2%} of the "
+             f"update stage exceeds the {REPLAY_BUDGET_FRAC:.0%} budget "
+             f"(insert {diag.get('replay_insert_us')}us, sample "
+             f"{diag.get('replay_sample_us')}us)")
+    ratio = diag.get("replay_sampled_vs_fresh_fps")
+    if ratio is not None and ratio < REPLAY_SAMPLED_FPS_FLOOR:
+        flag(f"REPLAY: sampled-update fps is {ratio:.3f}x fresh "
+             f"(floor {REPLAY_SAMPLED_FPS_FLOOR}x; fresh "
+             f"{diag.get('replay_fresh_update_fps')} vs sampled "
+             f"{diag.get('replay_sampled_update_fps')} env_frames/s)")
+
+    curve = diag.get("replay_ratio_curve")
+    if not curve:
+        return  # stage never ran (its own error already recorded)
+    anchor = next((row for row in curve if row[0] == 0), None)
+    if anchor is None or anchor[1] is None:
+        diag["errors"].append(
+            "REPLAY: curve has no R=0 anchor — the regression guard "
+            "is unarmed")
+        return
+    for row in curve:
+        ratio_r, final_return, final_loss = row[0], row[1], row[2]
+        if ratio_r == 0:
+            continue
+        if final_loss is None or not math.isfinite(final_loss):
+            diag["errors"].append(
+                f"REPLAY: R={ratio_r} arm ended with non-finite loss "
+                f"{final_loss} — replayed updates are destabilizing "
+                f"the surrogate")
+            continue
+        if final_return is None:
+            continue
+        if final_return < REPLAY_CURVE_FLOOR_FRAC * anchor[1]:
+            msg = (
+                f"REPLAY: algorithmic regression — R={ratio_r} final "
+                f"return {final_return} fell below "
+                f"{REPLAY_CURVE_FLOOR_FRAC:.0%} of the R=0 anchor "
+                f"{anchor[1]}")
+            if ratio_r <= 2:
+                diag["errors"].append(msg)
+            else:
+                diag.setdefault("warnings", []).append(
+                    msg + " (R>2: advisory)")
+
+
 def bench_fleet(diag):
     """Fleet fault-domain stage (ISSUE 5): the peer-health layer's unit
     costs and their implied share of the update stage.  The layer puts
@@ -2597,6 +2825,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "bench_resilience failed: " + traceback.format_exc(limit=3))
+    diag["stage"] = "bench_replay"
+    try:
+        bench_replay(
+            diag, budget_s=300.0 if diag["platform"] != "cpu" else 240.0)
+    except Exception:
+        diag["errors"].append(
+            "bench_replay failed: " + traceback.format_exc(limit=3))
     diag["stage"] = "bench_fleet"
     try:
         bench_fleet(diag)
@@ -2674,6 +2909,13 @@ def main():
     except Exception:
         diag["errors"].append(
             "resilience regression guard failed: "
+            + traceback.format_exc(limit=2))
+    diag["stage"] = "replay_regression_guard"
+    try:
+        replay_regression_guard(diag)
+    except Exception:
+        diag["errors"].append(
+            "replay regression guard failed: "
             + traceback.format_exc(limit=2))
     diag["stage"] = "fleet_regression_guard"
     try:

@@ -150,6 +150,32 @@ class Config:
     # auto picks time_sharded when mesh_seq > 1, the fused Pallas kernel
     # on a single-device TPU mesh, associative else.
     scan_impl: str = "auto"
+    # -- off-policy replay (runtime/replay.py, ops/impact.py;
+    # docs/performance.md "Replay & the off-policy dial") ----------------
+    # Loss surrogate: "vtrace" (the seed objective, bit-for-bit) or
+    # "impact" (clipped-target surrogate with a target network riding
+    # in TrainState — tolerates far staler data, the objective replay
+    # needs).
+    loss: str = "vtrace"
+    # Replayed updates per fresh batch: every fresh batch's packed
+    # upload also lands in the device-resident replay slab, and R
+    # uniformly sampled batches ride behind each fresh update — the
+    # learner-throughput dial that decouples learner fps from actor
+    # fps.  0 disables replay entirely (no slab is ever allocated).
+    # Replayed updates do NOT advance env_frames (fresh frames count
+    # exactly once) and are tuned against the
+    # ledger/staleness_replayed_s split.
+    replay_ratio: int = 0
+    # Replay slab capacity in whole batches.  Device HBM cost is
+    # capacity x packed-batch bytes; contents are intentionally not
+    # checkpointed (docs/robustness.md, replay warm-up after restore).
+    replay_capacity: int = 64
+    # IMPACT target network: hard-copy the online params into the
+    # target every this many FRESH updates (in-graph, no extra sync).
+    target_update_interval: int = 100
+    # IMPACT surrogate ratio clip epsilon (pi_theta/pi_target outside
+    # [1-eps, 1+eps] stops contributing gradient).
+    impact_clip_epsilon: float = 0.3
     checkpoint_interval_s: float = 600.0  # reference: experiment.py:611-612
     checkpoint_keep: int = 5
     log_interval_s: float = 10.0

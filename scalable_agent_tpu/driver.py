@@ -808,6 +808,11 @@ def train(config: Config) -> Dict[str, float]:
         agent = build_agent(config, action_space)
 
         learner = build_training_learner(config, agent)
+        # Device-resident replay (runtime/replay.py): every fresh
+        # batch's packed upload also lands in the slab, and
+        # --replay_ratio replayed updates ride behind each fresh one —
+        # None (and nothing allocated) when the dial is at 0.
+        replay = build_replay(config, learner)
 
         # gloo (the multi-process CPU collectives transport) pairs ops
         # by ARRIVAL order per process-pair: no two programs with
@@ -1007,6 +1012,30 @@ def train(config: Config) -> Dict[str, float]:
                 # and gloo mispairs anything that arrives alongside it.
                 jax.block_until_ready(state)
             watchdog.touch("learner")
+            if replay is not None:
+                # The off-policy dial: R replayed updates behind every
+                # fresh batch — on-device sample + unpack + update,
+                # env_frames held (fresh frames count exactly once),
+                # metrics through the same in-flight window with no
+                # provenance record (the batch's frames were accounted
+                # at fresh consumption; its AGE lands in
+                # ledger/staleness_replayed_s at sample time).
+                for _ in range(config.replay_ratio):
+                    with timing.time_avg("update"), \
+                            interval.add_time("update"), \
+                            get_tracer().span("learner/replay_update",
+                                              cat="learner"):
+                        rtraj = replay.sample()
+                        state, dispatched = learner.update(
+                            state, rtraj, fresh=False)
+                    inflight.push(dispatched, ledger_id=None)
+                    updates += 1
+                    if inflight.full:
+                        with timing.time_avg("retire"), \
+                                interval.add_time("retire"), \
+                                fleet.collective("retire_update"):
+                            metrics = inflight.retire()
+                    watchdog.touch("learner")
             pool.set_params(state.params, version=updates)
             updates += 1
             frames += frames_per_update
@@ -1339,6 +1368,25 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
         raise ValueError(
             f"inflight_updates must be >= 1, got "
             f"{config.inflight_updates}")
+    if config.loss not in ("vtrace", "impact"):
+        raise ValueError(
+            f"unknown loss {config.loss!r} (vtrace | impact)")
+    if config.replay_ratio < 0:
+        raise ValueError(
+            f"replay_ratio must be >= 0, got {config.replay_ratio}")
+    if config.replay_ratio > 0 and config.replay_capacity < 1:
+        raise ValueError(
+            f"replay_capacity must be >= 1 with replay enabled, got "
+            f"{config.replay_capacity}")
+    if (config.replay_ratio > 0 and config.train_backend == "host"
+            and transport != "packed"):
+        # The host backend's replay insert IS the packed upload landing
+        # in the slab (runtime/replay.py); the per-leaf path has no
+        # single device buffer to tap.  This also covers the
+        # multi-process-CPU gloo downgrade above.
+        raise ValueError(
+            "replay_ratio > 0 requires --transport=packed on the host "
+            "backend (the replay slab is fed by the packed upload)")
     if config.mesh_seq > 1 and config.unroll_length % config.mesh_seq:
         raise ValueError(
             f"unroll_length {config.unroll_length} not divisible by "
@@ -1364,7 +1412,41 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     # keeps one source of truth.
     return Learner(agent, hp, mesh, config.frames_per_update(),
                    scan_impl=config.scan_impl,
-                   transport=transport)
+                   transport=transport,
+                   loss=config.loss,
+                   target_update_interval=config.target_update_interval,
+                   impact_clip_epsilon=config.impact_clip_epsilon)
+
+
+def build_replay(config: Config, learner: Learner):
+    """The device replay slab for one training run (None when replay is
+    off — the dial's zero position allocates nothing).  Host backend:
+    the slab stores the packed transport's uploaded buffers and samples
+    unpack through the transport's existing jitted unpack; the insert
+    tap carries the current ledger record's birth stamp so
+    ``ledger/staleness_replayed_s`` measures true frame age."""
+    if config.replay_ratio <= 0:
+        return None
+    from scalable_agent_tpu.runtime.replay import DeviceReplayBuffer
+
+    transport = learner._transport
+    from scalable_agent_tpu.runtime.transport import PackedTransport
+
+    if not isinstance(transport, PackedTransport):
+        raise ValueError(
+            "replay requires the packed transport on the host backend")
+    replay = DeviceReplayBuffer(
+        config.replay_capacity, seed=config.seed,
+        postprocess=transport.unpack)
+
+    def sink(device_buf):
+        ledger = get_ledger()
+        tid = ledger.current()
+        birth = ledger.birth_us(tid) if tid is not None else None
+        replay.insert(device_buf, birth_us=birth)
+
+    transport.set_upload_sink(sink)
+    return replay
 
 
 # How many fused updates may be dispatched-but-unretired before the
@@ -1429,7 +1511,18 @@ def train_ingraph(config: Config) -> Dict[str, float]:
 
     learner = build_training_learner(config, agent)
     trainer = InGraphTrainer(agent, learner, env, config.unroll_length,
-                             config.batch_size, seed=config.seed)
+                             config.batch_size, seed=config.seed,
+                             emit_trajectory=config.replay_ratio > 0)
+    # Device replay for the fused backend: the unroll's device-born
+    # Trajectory pytree goes straight into the slab (no transport in
+    # this backend, so no packed buffer to store — the per-leaf slabs
+    # carry the same batch sharding the rollout constrains).
+    replay = None
+    if config.replay_ratio > 0:
+        from scalable_agent_tpu.runtime.replay import DeviceReplayBuffer
+
+        replay = DeviceReplayBuffer(config.replay_capacity,
+                                    seed=config.seed)
     state, carry = trainer.init(jax.random.key(config.seed))
 
     ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
@@ -1523,10 +1616,37 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # (jax.random.fold_in), so resume continues the exact
                     # action-sampling stream the interrupted run would
                     # have used.
-                    state, carry, metrics = trainer.train_step(
-                        state, carry, np.int32(updates))
+                    if replay is None:
+                        state, carry, metrics = trainer.train_step(
+                            state, carry, np.int32(updates))
+                    else:
+                        state, carry, metrics, fresh_traj = (
+                            trainer.train_step(state, carry,
+                                               np.int32(updates)))
                 ledger.stamp(ledger_tid, "dispatch")
                 pending_tids.append(ledger_tid)
+                if replay is not None:
+                    # Same off-policy dial as the host backend: the
+                    # fresh unroll lands in the slab, then R replayed
+                    # updates (env_frames held, no provenance record —
+                    # only their age is observed) chase it.  The
+                    # replayed dict carries loss keys only — the FRESH
+                    # step's metrics keep the log line's episode stats,
+                    # with the loss readings taken from the last
+                    # replayed update (the freshest param state).
+                    replay.insert(fresh_traj)
+                    for _ in range(config.replay_ratio):
+                        with timing.time_avg("update"), \
+                                get_tracer().span(
+                                    "learner/replay_update",
+                                    cat="learner"):
+                            rtraj = replay.sample()
+                            state, tel, replay_metrics = (
+                                trainer.replay_step(
+                                    state, carry.telemetry, rtraj))
+                            carry = carry._replace(telemetry=tel)
+                            metrics = dict(metrics, **replay_metrics)
+                        updates += 1
                 # Bound the open-record stream: a fused run fast enough
                 # to dispatch thousands of updates inside one log
                 # interval would overflow the ledger's open-record
@@ -1822,7 +1942,12 @@ def test(config: Config) -> Dict[str, List[float]]:
         saved = Config.load(saved_path)
         config = dataclasses.replace(
             config, torso_type=saved.torso_type,
-            use_instruction=saved.use_instruction)
+            use_instruction=saved.use_instruction,
+            # The loss shapes the TrainState (--loss=impact carries a
+            # target network): the restore TEMPLATE must match the
+            # checkpoint's generation so the structure retry in
+            # runtime/checkpoint.py stays the exception, not the rule.
+            loss=saved.loss)
     suite = config.level_name == "dmlab30"
     level_names = ([f"dmlab_{name}" for name in dmlab30.TEST_LEVELS]
                    if suite else [config.level_name])

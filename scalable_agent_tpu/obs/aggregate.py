@@ -261,6 +261,11 @@ def _fleet_fold(family: str, metric: str, kind: str,
     # reports the worst (max) observed recovery.
     if "fleet_epoch" in metric or metric.endswith("fleet_mttr_s"):
         return "max"
+    # The IMPACT anchor cadence (runtime/learner.py) is one config
+    # value replicated on every process — summing it would inflate the
+    # report's staleness budget N-fold.
+    if metric.endswith("target_update_interval"):
+        return "max"
     # Occupancy BEFORE the quantile rule: the runtime's occupancy
     # instruments are histograms (quantile-labelled summaries), and the
     # fleet question is "who is most starved" — min — for every series

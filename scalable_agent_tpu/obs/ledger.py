@@ -127,8 +127,14 @@ SEGMENTS = (
 # batch formation; busy seconds are summed request waits, so ρ is
 # Little's-law L — how many requests sit parked) and ``service_batch``
 # (the one inference thread's batched execution; ρ is its true
-# utilization).
-SERVICE_STAGES = ("inference_service", "service_wait", "service_batch")
+# utilization).  ``replay_insert``/``replay_sample`` are the device
+# replay slab's two host-dispatch points (runtime/replay.py) — also
+# beside the per-trajectory path: a replayed batch re-enters the
+# learner without a new provenance record (its frames were accounted at
+# fresh consumption), so its cost shows up here as rate + busy share,
+# and its AGE in ``ledger/staleness_replayed_s``.
+SERVICE_STAGES = ("inference_service", "service_wait", "service_batch",
+                  "replay_insert", "replay_sample")
 
 # The subset of SERVICE_STAGES whose ρ is a genuine utilization in
 # [0, 1] (one server's busy seconds per wall second) — the stages
@@ -147,6 +153,8 @@ SEGMENT_LABELS = {
     "inference_service": "dynamic-batching inference service",
     "service_wait": "actor-service request wait (batch formation)",
     "service_batch": "actor-service batched inference execution",
+    "replay_insert": "replay slab insert dispatch (device-side write)",
+    "replay_sample": "replay slab sample dispatch (gather + unpack)",
 }
 
 # Every *timing* histogram the runtime registers (names ending `_s`,
@@ -170,6 +178,8 @@ TIMING_STAGE_MAP = {
     # enqueue → action spans wait + execution; under load the wait half
     # dominates, so the latency histogram reads with the wait stage.
     "service/request_latency_s": "service_wait",
+    "replay/insert_s": "replay_insert",
+    "replay/sample_s": "replay_sample",
 }
 
 # Peak bf16 matmul FLOP/s per chip by jax device_kind prefix — the ONE
@@ -309,8 +319,16 @@ class PipelineLedger:
                         if (led := self_ref()) is not None else 0.0))
         self._h_staleness = reg.histogram(
             "ledger/staleness_s",
-            "frame age at consumption: unroll birth -> update retire "
-            "(the staleness metric IMPACT-style replay tunes against)")
+            "FRESH frame age at consumption: unroll birth -> update "
+            "retire (the staleness metric IMPACT-style replay tunes "
+            "against; replayed consumptions read the _replayed series "
+            "so this histogram stays honest when replay_ratio > 0)")
+        self._h_staleness_replayed = reg.histogram(
+            "ledger/staleness_replayed_s",
+            "REPLAYED frame age at consumption: unroll birth -> replay "
+            "sample (runtime/replay.py's deterministic slot mirror — "
+            "the dial obs.report judges the IMPACT clip's useful range "
+            "against)")
         self._g_mfu = reg.gauge(
             "ledger/mfu",
             "live model FLOPs utilization: flops_per_update x retire "
@@ -446,6 +464,19 @@ class PipelineLedger:
     # abandon paths read as intent ("drop this binding") rather than
     # as a discarded lookup.
     unbind = lookup
+
+    def birth_us(self, tid: int) -> Optional[int]:
+        """An OPEN record's birth stamp (ledger clock) — the replay
+        insert path reads it to tag the slot's age source; None once
+        the record closed (the caller then falls back to now)."""
+        record = self._open.get(tid)
+        return None if record is None else record.stamps.get("birth")
+
+    def observe_replay_staleness(self, age_s: float) -> None:
+        """One replayed consumption's frame age (runtime/replay.py's
+        host-side slot mirror) — the replayed half of the staleness
+        split."""
+        self._h_staleness_replayed.observe(max(0.0, float(age_s)))
 
     def set_current(self, tid: Optional[int]) -> None:
         """Thread-local cursor: the prefetch thread sets it at queue_get

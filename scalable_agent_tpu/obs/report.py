@@ -321,6 +321,48 @@ def build_report(logdir: str,
     report["staleness_s"] = {
         q: _value(families, "ledger/staleness_s", quantile=q)
         for q in ("0.5", "0.95", "0.99")}
+    # The replayed half of the staleness split (runtime/replay.py):
+    # present only when --replay_ratio > 0 fed the slab.
+    report["staleness_replayed_s"] = {
+        q: _value(families, "ledger/staleness_replayed_s", quantile=q)
+        for q in ("0.5", "0.95", "0.99")}
+    replay = {
+        "occupancy": _value(families, "replay/occupancy"),
+        "inserted": _value(families, "replay/insert_total"),
+        "sampled": _value(families, "replay/sampled_total"),
+        "target_update_interval": _value(
+            families, "replay/target_update_interval"),
+    }
+    # Keyed on the SLAB's own series, not target_update_interval: an
+    # --loss=impact run with replay off still publishes the anchor
+    # cadence gauge, and must not draw a phantom slab section.
+    report["replay"] = (
+        replay if any(replay[key] is not None
+                      for key in ("occupancy", "inserted", "sampled"))
+        else None)
+
+    # The off-policy dial's own recommendation: the IMPACT clip anchors
+    # on a target net refreshed every target_update_interval updates,
+    # so replayed data older than ~one refresh period (interval /
+    # update rate) predates the anchor — its importance weights clip
+    # away and the replayed updates stop buying learning.
+    replay_rec = None
+    replayed_p95 = report["staleness_replayed_s"]["0.95"]
+    interval = replay["target_update_interval"]
+    update_rate = (report["stages"].get("device") or {}).get(
+        "rate_per_s")
+    if replayed_p95 and interval and update_rate:
+        budget_s = interval / update_rate
+        if replayed_p95 > budget_s:
+            replay_rec = (
+                f"replayed staleness p95 {replayed_p95:.3f}s exceeds "
+                f"the IMPACT clip's useful range (~{budget_s:.3f}s = "
+                f"target_update_interval {interval:.0f} / "
+                f"{update_rate:.2f} updates/s): lower --replay_ratio "
+                f"or --replay_capacity, or raise "
+                f"--target_update_interval so the anchor outlives the "
+                f"slab")
+    report["replay_recommendation"] = replay_rec
     report["mfu"] = _value(families, "ledger/mfu")
     report["learner_fps"] = _value(families, "learner/fps")
     report["actor_fps"] = _value(families, "actor/fps")
@@ -454,12 +496,28 @@ def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
     lines.append("")
 
     staleness = report["staleness_s"]
+    labels = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}
     if any(v is not None for v in staleness.values()):
-        labels = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}
         lines.append(
-            "staleness (frame age at consumption): "
+            "staleness (FRESH frame age at consumption): "
             + "  ".join(f"{labels[q]} {_fmt(staleness[q], '.3f')}s"
                         for q in ("0.5", "0.95", "0.99")))
+    replayed = report["staleness_replayed_s"]
+    if any(v is not None for v in replayed.values()):
+        lines.append(
+            "staleness (REPLAYED frame age at sample): "
+            + "  ".join(f"{labels[q]} {_fmt(replayed[q], '.3f')}s"
+                        for q in ("0.5", "0.95", "0.99")))
+    replay = report["replay"]
+    if replay:
+        lines.append(
+            f"replay slab: occupancy "
+            f"{_fmt(replay['occupancy'], '.2f')}, "
+            f"{_fmt(replay['inserted'], '.0f')} inserted, "
+            f"{_fmt(replay['sampled'], '.0f')} sampled")
+    if report["replay_recommendation"]:
+        lines.append(
+            "replay recommendation: " + report["replay_recommendation"])
     mfu = report["mfu"]
     lines.append(
         f"mfu: {_fmt(mfu, '.4g') if mfu is not None else 'n/a'}   "

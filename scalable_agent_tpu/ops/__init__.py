@@ -1,5 +1,6 @@
 # NOTE: the Pallas modules (lstm_pallas, vtrace_pallas) are deliberately
 # NOT imported here — their consumers import them lazily at the use site
 # so the XLA-only paths never pay (or depend on) the Pallas TPU imports.
+from scalable_agent_tpu.ops import impact
 from scalable_agent_tpu.ops import losses
 from scalable_agent_tpu.ops import vtrace

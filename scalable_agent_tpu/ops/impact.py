@@ -1,0 +1,101 @@
+"""IMPACT clipped-target surrogate (Luo et al., arXiv:1912.00167).
+
+The off-policy dial ROADMAP item 2 needs: raw V-trace degrades as the
+behaviour policy ages (the importance ratio π_θ/μ drifts and the clip
+throws the sample away), so a learner fed from replay — where frame age
+is a *throughput choice*, not an accident — needs a surrogate built to
+tolerate staleness.  IMPACT's construction:
+
+- a **target network** π_tgt (a periodic hard copy of the online
+  params, riding in ``TrainState.target_params``) anchors the
+  surrogate.  The behaviour→target correction ``β = min(c̄, π_tgt/μ)``
+  is exactly V-trace's clipped pg-rho with the TARGET network as the
+  "target policy" — so the advantage the learner sees is already
+  β-weighted by ``vtrace.from_logits(target_policy_logits=π_tgt, ...)``
+  (ops/vtrace.py), and this module only adds the clipped ratio term.
+- the **clipped-target surrogate** itself is PPO-shaped but measured
+  against the *target* network rather than the behaviour policy::
+
+      r_t(θ) = π_θ(a_t|s_t) / π_tgt(a_t|s_t)
+      L = -Σ min( r_t · Â_t, clip(r_t, 1-ε, 1+ε) · Â_t )
+
+  Because π_tgt moves only every ``target_update_interval`` updates,
+  r_t stays near 1 no matter how stale the *behaviour* data is — the
+  property that turns ``replay_ratio`` into a throughput dial instead
+  of a divergence dial.
+
+Loss terms are SUMS over time and batch, matching ops/losses.py (so
+entropy_cost/baseline_cost transfer unchanged between ``--loss=vtrace``
+and ``--loss=impact``).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from scalable_agent_tpu.ops import distributions
+
+__all__ = ["ImpactSurrogate", "surrogate_from_logits"]
+
+
+class ImpactSurrogate(NamedTuple):
+    """The clipped-target policy loss plus its diagnostics.
+
+    loss: scalar (negated summed surrogate — minimize it).
+    ratio_mean: mean of r_t = π_θ/π_tgt over the batch (≈1 when the
+        online net hugs the target; drift here is the staleness
+        instrument the obs plane reads).
+    clip_fraction: fraction of (t, b) cells where the clip bound was
+        the active side of the min — the surrogate's own "how stale is
+        my data" gauge.
+    """
+
+    loss: jax.Array
+    ratio_mean: jax.Array
+    clip_fraction: jax.Array
+
+
+def surrogate_from_logits(
+    online_logits,
+    target_logits,
+    actions,
+    advantages,
+    clip_epsilon: float = 0.3,
+    dist_spec: Optional[distributions.DistributionSpec] = None,
+) -> ImpactSurrogate:
+    """IMPACT surrogate from logits.
+
+    online_logits/target_logits: [T, B, NUM_LOGITS]; actions [T, B]
+    ([T, B, K] composite with ``dist_spec``); ``advantages`` [T, B] are
+    the β-weighted V-trace pg-advantages (computed with the TARGET
+    network as V-trace's target policy — the β = min(c̄, π_tgt/μ)
+    correction is V-trace's clipped pg-rho, not re-applied here).
+    """
+    if clip_epsilon <= 0.0:
+        raise ValueError(
+            f"impact clip_epsilon must be > 0, got {clip_epsilon}")
+    online_logits = jnp.asarray(online_logits, jnp.float32)
+    target_logits = jnp.asarray(target_logits, jnp.float32)
+    actions = jnp.asarray(actions, jnp.int32)
+    if dist_spec is None:
+        dist_spec = distributions.DistributionSpec(
+            sizes=(online_logits.shape[-1],))
+    lp_online = distributions.log_prob(online_logits, actions, dist_spec)
+    # No gradient flows into the target net anyway (its params are a
+    # separate TrainState field), but the stop_gradient documents the
+    # anchor role and keeps the tape minimal.
+    lp_target = lax.stop_gradient(
+        distributions.log_prob(target_logits, actions, dist_spec))
+    ratio = jnp.exp(lp_online - lp_target)
+    adv = lax.stop_gradient(jnp.asarray(advantages, jnp.float32))
+    clipped = jnp.clip(ratio, 1.0 - clip_epsilon, 1.0 + clip_epsilon)
+    objective = jnp.minimum(ratio * adv, clipped * adv)
+    loss = -jnp.sum(objective)
+    clip_active = (clipped * adv < ratio * adv)
+    return ImpactSurrogate(
+        loss=loss,
+        ratio_mean=jnp.mean(ratio),
+        clip_fraction=jnp.mean(clip_active.astype(jnp.float32)),
+    )

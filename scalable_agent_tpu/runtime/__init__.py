@@ -40,6 +40,7 @@ from scalable_agent_tpu.runtime.learner import (
     TrainState,
     Trajectory,
 )
+from scalable_agent_tpu.runtime.replay import DeviceReplayBuffer
 from scalable_agent_tpu.runtime.transport import (
     InflightWindow,
     PackedTransport,

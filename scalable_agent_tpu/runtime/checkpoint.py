@@ -58,6 +58,20 @@ _MANIFEST_SCHEMA = 1
 # nonfinite_streak) was saved with — the legacy-migration restore target.
 _LEGACY_FIELDS = ("params", "opt_state", "env_frames")
 
+# TrainState fields a pre-IMPACT checkpoint (before target_params) was
+# saved with.  Orbax records even a None field in the tree structure,
+# so restores that cross the IMPACT generation boundary IN EITHER
+# DIRECTION need a structure retry (see _restore_step):
+# - an --loss=impact run resuming a pre-IMPACT (or vtrace) checkpoint
+#   retries with target_params=None, and Learner.place_state then
+#   initializes the target net from the restored online params;
+# - a --loss=vtrace run resuming an --loss=impact checkpoint retries
+#   with the online params as the target's shape donor and carries the
+#   restored target through untouched (the vtrace update ignores it),
+#   so the checkpoint's integrity manifest still verifies leaf-exact.
+_PRE_IMPACT_FIELDS = ("params", "opt_state", "env_frames",
+                      "nonfinite_skips", "nonfinite_streak")
+
 
 class CheckpointIntegrityError(RuntimeError):
     """Retained checkpoint steps exist but NONE restored and verified.
@@ -334,6 +348,64 @@ class CheckpointManager:
             return self._manager.restore(
                 step, args=ocp.args.StandardRestore(host_target))
         except Exception:
+            if host_target is None or not isinstance(host_target,
+                                                     TrainState):
+                raise
+            # IMPACT-generation migration (loss-mode crossing, either
+            # direction).  A structure mismatch here fails fast in
+            # orbax's key validation, before the array reads — so a
+            # genuinely torn step pays at most one wasted retry and
+            # the walk-back still proceeds.
+            if host_target.target_params is not None:
+                # impact run <- pre-IMPACT/vtrace checkpoint: restore
+                # the narrower structure; the target net is
+                # initialized from the online params AFTER manifest
+                # verification (Learner.place_state).
+                try:
+                    restored = self._manager.restore(
+                        step, args=ocp.args.StandardRestore(
+                            host_target._replace(target_params=None)))
+                    log.warning(
+                        "checkpoint step %d predates the IMPACT "
+                        "target network; target params will be "
+                        "initialized from the restored online params",
+                        step)
+                    return restored
+                except Exception:
+                    pass
+            else:
+                # vtrace run <- impact checkpoint: the online params
+                # donate the target subtree's structure; the restored
+                # target rides along untouched so the per-leaf CRC
+                # manifest still verifies the full checkpoint.
+                try:
+                    restored = self._manager.restore(
+                        step, args=ocp.args.StandardRestore(
+                            host_target._replace(
+                                target_params=host_target.params)))
+                    log.warning(
+                        "checkpoint step %d carries an IMPACT target "
+                        "network; restored under --loss=vtrace it is "
+                        "carried through unused", step)
+                    return restored
+                except Exception:
+                    pass
+            # Pre-PR trees: a checkpoint written before target_params
+            # existed AT ALL has no entry for it (not even a None
+            # placeholder), so both 6-field retries above mismatch —
+            # restore the plain 5-field structure and let the default
+            # None widen it.
+            try:
+                restored = self._manager.restore(
+                    step, args=ocp.args.StandardRestore(
+                        {name: getattr(host_target, name)
+                         for name in _PRE_IMPACT_FIELDS}))
+                log.warning(
+                    "checkpoint step %d restored via the pre-IMPACT "
+                    "5-field structure", step)
+                return TrainState(**restored)
+            except Exception:
+                pass
             # Legacy migration: checkpoints written before the guard
             # counters existed carry a 3-field TrainState; a structure
             # mismatch against the widened target must not read as
@@ -345,9 +417,7 @@ class CheckpointManager:
             # the manifests, while a torn post-guard step has one — so
             # the walk-back never pays a doubled full read per rejected
             # modern step.
-            if (host_target is None
-                    or not isinstance(host_target, TrainState)
-                    or os.path.exists(self._manifest_path(step))):
+            if os.path.exists(self._manifest_path(step)):
                 raise
             legacy_target = {name: getattr(host_target, name)
                              for name in _LEGACY_FIELDS}

@@ -8,6 +8,10 @@ runtime's failure-prone seams —
 
 - ``nan_grad``   (runtime/learner.py): poison one update's rewards with
   NaN so the non-finite guard must skip it.
+- ``replay_corrupt`` (runtime/replay.py): poison one SAMPLED replay
+  batch's rewards with NaN — the same non-finite guard must absorb the
+  replayed update as a bit-exact no-op and the skip counter must
+  attribute it (occurrences count replay samples).
 - ``actor_raise`` (runtime/actor.py): raise ``InjectedFault`` from an
   actor thread's unroll loop, exercising the bounded-respawn retry.
 - ``worker_kill`` (runtime/actor.py): SIGKILL one env worker process,

@@ -88,6 +88,7 @@ class InGraphTrainer:
         unroll_length: int,
         batch: int,
         seed: int = 0,
+        emit_trajectory: bool = False,
     ):
         self._agent = agent
         self._learner = learner
@@ -95,6 +96,12 @@ class InGraphTrainer:
         self._unroll_length = unroll_length
         self._batch = batch
         self._seed = int(seed)
+        # Replay tap (runtime/replay.py): when set, train_step ALSO
+        # returns the unroll's device-resident Trajectory so the driver
+        # can insert it into the replay slab — extra HBM output, zero
+        # host traffic.  Off (the default) the fused program is
+        # unchanged.
+        self._emit_trajectory = bool(emit_trajectory)
         # Shard the rollout over the learner's data axis: one constraint
         # on the carry propagates through the scan, so env transitions
         # and agent inference compute on their batch shard's device
@@ -109,6 +116,11 @@ class InGraphTrainer:
             self._tel_specs.append(learner.devtel_spec)
         self._tel_publisher = TelemetryPublisher(self._tel_specs)
         self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
+        # Replayed-batch update: the learner's fresh=False
+        # specialization driven with THIS trainer's merged telemetry
+        # pytree (donated, like the fused step's carry).
+        self.replay_step = jax.jit(self._replay_step,
+                                   donate_argnums=(0, 1))
 
     # -- initialization ----------------------------------------------------
 
@@ -206,7 +218,18 @@ class InGraphTrainer:
             finished, emitted.info.episode_return, 0.0)) / denom
         metrics["episode_frames"] = jnp.sum(jnp.where(
             finished, steps, 0)).astype(jnp.float32) / denom
-        return new_state, TrainCarry(new_rollout, telemetry), metrics
+        out_carry = TrainCarry(new_rollout, telemetry)
+        if self._emit_trajectory:
+            return new_state, out_carry, metrics, trajectory
+        return new_state, out_carry, metrics
+
+    def _replay_step(self, state, telemetry, trajectory):
+        """One REPLAYED update (env_frames held, target-net schedule
+        held — runtime/learner.py fresh=False).  Returns
+        ``(new_state, new_telemetry, metrics)``; the caller rebinds the
+        carry's telemetry."""
+        return self._learner._update_impl(
+            state, trajectory, telemetry, fresh=False)
 
     # -- host loop ---------------------------------------------------------
 
@@ -216,8 +239,10 @@ class InGraphTrainer:
         ``float(np.asarray(metrics['total_loss']))``)."""
         metrics = None
         for i in range(num_updates):
+            # [:3] tolerates the emit_trajectory variant (the emitted
+            # trajectory is dropped here — run() callers don't replay).
             state, carry, metrics = self.train_step(
-                state, carry, np.int32(counter_start + i))
+                state, carry, np.int32(counter_start + i))[:3]
         return state, carry, metrics
 
     # -- telemetry (host side, log-interval cadence) -----------------------

@@ -43,6 +43,7 @@ from scalable_agent_tpu.obs.device_telemetry import (
     DeviceTelemetry,
     TelemetryPublisher,
 )
+from scalable_agent_tpu.ops import impact as impact_lib
 from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
 from scalable_agent_tpu.parallel.mesh import (
@@ -102,6 +103,16 @@ class TrainState(NamedTuple):
     # run keeps its skip accounting.
     nonfinite_skips: jax.Array
     nonfinite_streak: jax.Array
+    # IMPACT target network (ops/impact.py): a periodic hard copy of
+    # ``params`` anchoring the clipped-target surrogate, refreshed
+    # in-graph every ``target_update_interval`` fresh updates.  None
+    # under ``--loss=vtrace`` (a None pytree node carries zero leaves,
+    # so the default path's TrainState allocates nothing new and its
+    # checkpoint bytes are unchanged); populated under
+    # ``--loss=impact`` and carried through the checkpoint so a resumed
+    # run keeps its anchor (runtime/checkpoint.py migrates checkpoints
+    # from either generation across the loss modes).
+    target_params: Any = None
 
 
 # Per-field batch-axis positions: agent_state leaves are [B, ...], the
@@ -174,11 +185,27 @@ class Learner:
         transport: str = "per_leaf",
         finite_guard: bool = True,
         device_telemetry: bool = True,
+        loss: str = "vtrace",
+        target_update_interval: int = 100,
+        impact_clip_epsilon: float = 0.3,
     ):
         self._agent = agent
         self._hp = hp
         self._mesh = mesh
         self._frames_per_update = float(frames_per_update)
+        # Loss surrogate: "vtrace" (the seed path, bit-for-bit) or
+        # "impact" (clipped-target surrogate, ops/impact.py — the
+        # replay-tolerant objective ROADMAP item 2 calls for).
+        if loss not in ("vtrace", "impact"):
+            raise ValueError(
+                f"unknown loss {loss!r} (vtrace | impact)")
+        if target_update_interval < 1:
+            raise ValueError(
+                f"target_update_interval must be >= 1, got "
+                f"{target_update_interval}")
+        self._loss_name = loss
+        self._target_update_interval = float(target_update_interval)
+        self._impact_clip_epsilon = float(impact_clip_epsilon)
         # The non-finite guard is fused into the jitted update (a
         # tree-wide isfinite reduction + per-leaf selects); ``False``
         # exists for bench_resilience's baseline measurement, not for
@@ -233,6 +260,15 @@ class Learner:
         # argument: accumulation is in-place on device, and the host
         # only touches it at the log-interval fetch.
         self._update = jax.jit(self._update_impl, donate_argnums=(0, 2))
+        # Replayed-batch variant: ``fresh=False`` is a PYTHON branch in
+        # _update_impl (env_frames held, no target-net sync), so the
+        # two jits are two specializations; the fresh one's jaxpr is
+        # byte-identical to the pre-replay program.
+        import functools
+
+        self._update_replayed = jax.jit(
+            functools.partial(self._update_impl, fresh=False),
+            donate_argnums=(0, 2))
         self._replicated = replicated
         self._devtel_enabled = bool(device_telemetry)
         self._devtel_spec = (learner_telemetry_spec()
@@ -258,6 +294,25 @@ class Learner:
         self._frames_counter = registry.counter(
             "learner/env_frames_total",
             "env frames consumed by dispatched updates")
+        self._replayed_counter = registry.counter(
+            "learner/replayed_updates_total",
+            "update steps dispatched on REPLAYED batches (their frames "
+            "were already counted at fresh consumption)")
+        if self._loss_name == "impact":
+            # The anchor cadence, published so obs.report can convert
+            # it into a staleness budget (interval / update rate) and
+            # judge the replayed-staleness p95 against the clip's
+            # useful range.
+            registry.gauge(
+                "replay/target_update_interval",
+                "fresh updates between IMPACT target-network hard "
+                "copies (the clipped-target surrogate's anchor "
+                "cadence)").set(self._target_update_interval)
+
+    @property
+    def loss_name(self) -> str:
+        """"vtrace" or "impact" — which surrogate the update compiles."""
+        return self._loss_name
 
     @property
     def mesh(self):
@@ -347,6 +402,12 @@ class Learner:
             env_frames=jnp.float32(env_frames),
             nonfinite_skips=jnp.float32(0.0),
             nonfinite_streak=jnp.float32(0.0),
+            # IMPACT: the target net starts as a DISTINCT copy of the
+            # online params (jnp.array copies) — aliased buffers would
+            # make the update's pytree donation try to donate the same
+            # buffer twice.
+            target_params=(jax.tree_util.tree_map(jnp.array, params)
+                           if self._loss_name == "impact" else None),
         )
         return self.place_state(state)
 
@@ -361,6 +422,10 @@ class Learner:
             env_frames=self._replicated,
             nonfinite_skips=self._replicated,
             nonfinite_streak=self._replicated,
+            target_params=(
+                None if state.target_params is None
+                else model_parallel_shardings(
+                    self._mesh, state.target_params)),
         )
 
     def place_state(self, state: TrainState) -> TrainState:
@@ -380,6 +445,19 @@ class Learner:
         seed; restore/rollback: the primary's state arrives by explicit
         broadcast), so the local build is also strictly cheaper: no
         params-sized network broadcast per init/restore."""
+        if self._loss_name == "impact" and state.target_params is None:
+            # Checkpoint migration (docs/robustness.md): a pre-IMPACT
+            # (or --loss=vtrace) checkpoint restored into an impact run
+            # initializes the target net FROM the online params — the
+            # host-level copy below lands as distinct device buffers,
+            # keeping the update's donation aliasing-free.  Runs AFTER
+            # restore()'s manifest verification, which checked the
+            # un-widened tree.
+            host_params = jax.tree_util.tree_map(
+                np.asarray, state.params)
+            state = state._replace(
+                target_params=jax.tree_util.tree_map(
+                    np.array, host_params))
         shardings = self.state_shardings(state)
         if jax.process_count() <= 1:
             return jax.device_put(state, shardings)
@@ -417,7 +495,14 @@ class Learner:
 
     # -- update -----------------------------------------------------------
 
-    def _loss(self, params, trajectory: Trajectory):
+    def _loss(self, params, trajectory: Trajectory, target_params=None):
+        """Dispatch on the construction-time surrogate choice (a Python
+        branch: each jit specialization compiles exactly one)."""
+        if self._loss_name == "impact":
+            return self._loss_impact(params, trajectory, target_params)
+        return self._loss_vtrace(params, trajectory)
+
+    def _loss_vtrace(self, params, trajectory: Trajectory):
         hp = self._hp
         # Target-policy unroll over the whole T+1 window (reference:
         # experiment.py:358-365).
@@ -476,15 +561,92 @@ class Learner:
             "entropy_loss": entropy_loss,
         }
 
+    def _loss_impact(self, params, trajectory: Trajectory, target_params):
+        """IMPACT clipped-target surrogate (ops/impact.py): V-trace
+        advantages computed with the TARGET network as the target
+        policy (so the β = min(c̄, π_tgt/μ) behaviour→target correction
+        is V-trace's clipped pg-rho), then the PPO-shaped ratio clip of
+        π_θ against π_tgt.  Baseline/entropy terms keep the vtrace
+        branch's shape so the cost hyperparameters transfer."""
+        hp = self._hp
+        (online_logits, baselines), _ = self._agent.apply(
+            params,
+            trajectory.agent_outputs.action,
+            trajectory.env_outputs,
+            trajectory.agent_state,
+        )
+        # Second (target-net) unroll: the staleness anchor.  Costs one
+        # extra forward — the price of tolerating arbitrarily stale
+        # behaviour data.
+        (anchor_logits, _), _ = self._agent.apply(
+            target_params,
+            trajectory.agent_outputs.action,
+            trajectory.env_outputs,
+            trajectory.agent_state,
+        )
+        bootstrap_value = baselines[-1]
+        behaviour = jax.tree_util.tree_map(
+            lambda t: t[1:], trajectory.agent_outputs)
+        env_outputs = jax.tree_util.tree_map(
+            lambda t: t[1:], trajectory.env_outputs)
+        online_logits = online_logits[:-1]
+        anchor_logits = anchor_logits[:-1]
+        baselines = baselines[:-1]
+
+        rewards = losses_lib.clip_rewards(
+            env_outputs.reward, hp.reward_clipping)
+        discounts = jnp.where(
+            env_outputs.done, 0.0, hp.discounting).astype(jnp.float32)
+
+        dist_spec = self._agent.dist_spec
+        vt = vtrace.from_logits(
+            behaviour_policy_logits=behaviour.policy_logits,
+            target_policy_logits=anchor_logits,
+            actions=behaviour.action,
+            discounts=discounts,
+            rewards=rewards,
+            values=baselines,
+            bootstrap_value=bootstrap_value,
+            clip_rho_threshold=hp.clip_rho_threshold,
+            clip_pg_rho_threshold=hp.clip_pg_rho_threshold,
+            scan_impl=self._scan_impl,
+            dist_spec=dist_spec,
+            mesh=self._mesh if self._scan_impl == "time_sharded" else None,
+        )
+
+        surrogate = impact_lib.surrogate_from_logits(
+            online_logits, anchor_logits, behaviour.action,
+            vt.pg_advantages,
+            clip_epsilon=self._impact_clip_epsilon,
+            dist_spec=dist_spec)
+        baseline_loss = losses_lib.compute_baseline_loss(
+            vt.vs - baselines)
+        entropy_loss = losses_lib.compute_entropy_loss(
+            online_logits, dist_spec=dist_spec)
+        total = (surrogate.loss + hp.baseline_cost * baseline_loss
+                 + hp.entropy_cost * entropy_loss)
+        return total, {
+            "total_loss": total,
+            "policy_gradient_loss": surrogate.loss,
+            "baseline_loss": baseline_loss,
+            "entropy_loss": entropy_loss,
+            "impact_ratio_mean": surrogate.ratio_mean,
+            "impact_clip_fraction": surrogate.clip_fraction,
+        }
+
     def _update_impl(self, state: TrainState, trajectory: Trajectory,
-                     devtel: Dict
+                     devtel: Dict, fresh: bool = True
                      ) -> Tuple[TrainState, Dict, Dict[str, jax.Array]]:
         """One update.  ``devtel`` is the device-telemetry pytree
         (donated; may carry other specs' leaves — e.g. the in-graph
         trainer's env instruments — which pass through untouched).
+        ``fresh`` is a PYTHON (specialization-time) flag: a replayed
+        batch's update holds env_frames (the frames were counted at
+        fresh consumption) and skips the target-net sync schedule.
         Returns ``(new_state, new_devtel, metrics)``."""
         (_, metrics), grads = jax.value_and_grad(
-            self._loss, has_aux=True)(state.params, trajectory)
+            self._loss, has_aux=True)(
+                state.params, trajectory, state.target_params)
 
         # Linear decay to 0 over total frames (reference:
         # experiment.py:409-412 polynomial_decay power=1).
@@ -531,12 +693,33 @@ class Learner:
             metrics["nonfinite_skips"] = skips
             metrics["nonfinite_streak"] = streak
 
+        target_params = state.target_params
+        if self._loss_name == "impact" and fresh:
+            # Periodic hard copy, fused into the update program (no
+            # host sync): the UPDATED params overwrite the target every
+            # ``target_update_interval`` fresh updates.  The schedule
+            # keys on the frame counter (exact multiples of
+            # frames_per_update, resume-exact like the LR schedule);
+            # replayed updates hold the counter, so they never advance
+            # the schedule.  The guard's `keep` select above already
+            # chose params vs state.params, so a skipped (non-finite)
+            # update syncs the HELD params — the target can never
+            # absorb a poisoned step.
+            k_next = (frames + self._frames_per_update) \
+                / self._frames_per_update
+            sync = jnp.mod(jnp.round(k_next),
+                           self._target_update_interval) == 0.0
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: jnp.where(sync, p, t),
+                state.target_params, params)
         new_state = TrainState(
             params=params,
             opt_state=opt_state,
-            env_frames=frames + self._frames_per_update,
+            env_frames=(frames + self._frames_per_update
+                        if fresh else frames),
             nonfinite_skips=skips,
             nonfinite_streak=streak,
+            target_params=target_params,
         )
         metrics["env_frames"] = new_state.env_frames
         if self._devtel_enabled:
@@ -558,10 +741,14 @@ class Learner:
                                   metrics["update_skipped"])
         return new_state, devtel, metrics
 
-    def update(self, state: TrainState, trajectory: Trajectory
+    def update(self, state: TrainState, trajectory: Trajectory,
+               fresh: bool = True
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         """One training step.  ``trajectory`` should already be on device
-        (``put_trajectory``) for best overlap; host batches also work."""
+        (``put_trajectory``) for best overlap; host batches also work.
+        ``fresh=False`` marks a REPLAYED batch (runtime/replay.py): the
+        update holds env_frames and the target-net schedule — the
+        frames were counted when the batch was consumed fresh."""
         injector = get_fault_injector()
         if injector.active and injector.should_fire("nan_grad"):
             # Chaos: poison this batch's rewards so the loss (and every
@@ -571,11 +758,15 @@ class Learner:
                     reward=trajectory.env_outputs.reward
                     * jnp.float32(float("nan"))))
         with get_tracer().span("learner/update", cat="learner"):
-            new_state, self._devtel, metrics = self._update(
+            update = self._update if fresh else self._update_replayed
+            new_state, self._devtel, metrics = update(
                 state, trajectory, self._devtel)
             out = (new_state, metrics)
         self._updates_counter.inc()
-        self._frames_counter.inc(self._frames_per_update)
+        if fresh:
+            self._frames_counter.inc(self._frames_per_update)
+        else:
+            self._replayed_counter.inc()
         # Step-number breadcrumb: a crash dump's ring then pins exactly
         # how far training got, independent of any metrics flush.
         get_flight_recorder().record(

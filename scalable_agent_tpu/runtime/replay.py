@@ -1,0 +1,338 @@
+"""Device-resident trajectory replay: the actor/learner decoupling dial.
+
+BENCH_r04's verdict is that the learner can consume ~2.75M env_frames/s
+while the pipeline delivers 12.6k — and ROADMAP item 2's conclusion is
+that no fixed actor fleet will ever close that gap with FRESH data, so
+the architecture should stop requiring it.  This module is that
+admission: a circular trajectory store that lives ON the device mesh
+(the "In-Network Experience Sampling" placement argument, PAPERS.md —
+the sample path's location dominates replay cost, and here it never
+leaves the chips), fed by the transport layer's existing single H2D
+upload, sampled by a jitted on-device gather.  With the IMPACT
+clipped-target surrogate (ops/impact.py) tolerating the extra staleness,
+``--replay_ratio=R`` turns into a throughput dial: R replayed updates
+ride behind every fresh batch, and actor fps and learner fps become
+independent knobs.
+
+Design:
+
+- **Storage** is a pytree of slabs, one per stored-tree leaf:
+  ``[capacity, *leaf_shape]``, sharded ``PartitionSpec(None, *leaf
+  spec)`` — slot-major over the SAME mesh axes the live batch uses, so
+  slot k of every slab holds shard-aligned rows and a gather never
+  moves bytes across devices.  Two producers use the same store:
+
+  * the host backend inserts the packed transport's UPLOADED buffer
+    (``PackedTransport.set_upload_sink`` — the slab write is a
+    device-side ``dynamic_update_slice`` of bytes that already paid
+    their one H2D copy; no second upload, no host-side buffer), and
+    samples are restored to Trajectories by the transport's existing
+    jitted unpack (``postprocess``);
+  * the in-graph backend inserts device-born Trajectory pytrees
+    directly.
+
+- **Sampling** is uniform over valid slots with a DEVICE-resident
+  counter-folded PRNG (``fold_in(key(seed), sample_counter)``) — the
+  same key math on every process, so all data shards gather the same
+  slot.  Insert and sample are jitted programs over device-resident
+  operands only: zero host→device transfers beyond the transport
+  upload that already existed, zero device→host syncs
+  (tests/test_replay.py proves both the PR 12 way —
+  ``jax.transfer_guard("disallow")`` + materialization spies).
+
+- **Staleness accounting without a sync**: the host cannot read the
+  sampled slot index without a fetch, so it doesn't — it REPLAYS the
+  same deterministic PRNG on the CPU backend (threefry is
+  backend-independent) against its mirrored counter/filled values,
+  recovers the identical slot, and feeds the slot's recorded birth
+  stamp into ``ledger/staleness_replayed_s``.  The fresh/replayed
+  split keeps the staleness histogram honest when R > 0
+  (obs/ledger.py).
+
+Buffer contents are deliberately NOT checkpointed: a restored run
+warms the buffer back up from its first fresh batches
+(docs/robustness.md, "Replay warm-up after restore").
+"""
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from scalable_agent_tpu.obs import get_ledger, get_registry
+from scalable_agent_tpu.obs.ledger import now_us
+from scalable_agent_tpu.runtime.faults import get_fault_injector
+from scalable_agent_tpu.runtime.transport import (
+    tree_flatten_with_none,
+    tree_unflatten,
+)
+
+__all__ = ["DeviceReplayBuffer"]
+
+
+def _slab_sharding(leaf):
+    """The slab sharding for one stored leaf: the leaf's own mesh spec
+    with a replicated slot axis in front (slot k's shard layout ==
+    the live leaf's).  None when the leaf's sharding isn't a
+    NamedSharding (the constraint is then skipped — correctness is
+    unaffected, XLA just chooses the layout)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return NamedSharding(
+            sharding.mesh, PartitionSpec(None, *sharding.spec))
+    return None
+
+
+class DeviceReplayBuffer:
+    """Circular device-resident trajectory store, sharded over the mesh.
+
+    ``capacity`` counts whole stored trees (one learner batch each).
+    ``postprocess`` maps a sampled stored tree to the Trajectory the
+    learner eats (the packed path passes the transport's jitted unpack;
+    the in-graph path stores Trajectories directly and passes None).
+    Thread model: one lock serializes ``insert``/``sample`` host
+    dispatch (the prefetch thread inserts while the update loop
+    samples); the device programs themselves are ordered by the jax
+    runtime.
+    """
+
+    def __init__(self, capacity: int, seed: int = 0,
+                 postprocess: Optional[Callable[[Any], Any]] = None,
+                 registry=None):
+        if capacity < 1:
+            raise ValueError(
+                f"replay capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._seed = int(seed)
+        self._postprocess = postprocess
+        self._lock = threading.Lock()
+        # Lazily built from the first inserted tree (shapes/dtypes are
+        # a runtime property of the env/transport).
+        self._slabs: Optional[List] = None
+        self._treedef = None
+        self._shardings: Optional[List] = None
+        self._insert_jit = None
+        self._sample_jit = None
+        # Device-resident ring state (i32 scalars; donated through the
+        # jitted insert/sample so the ring advances with no host sync).
+        self._cursor = None
+        self._filled = None
+        self._counter = None
+        # Host mirrors: exact copies of the device ring state, advanced
+        # by the same +1 arithmetic at dispatch time — they fund the
+        # occupancy gauge and the staleness mirror without ever reading
+        # the device.
+        self._host_filled = 0
+        self._host_cursor = 0
+        self._host_counter = 0
+        self._slot_birth_us: List[int] = [0] * self.capacity
+        registry = registry or get_registry()
+        self._c_inserts = registry.counter(
+            "replay/insert_total",
+            "trajectory batches inserted into the device replay slab")
+        self._c_samples = registry.counter(
+            "replay/sampled_total",
+            "trajectory batches sampled from the device replay slab")
+        import weakref
+
+        self_ref = weakref.ref(self)
+        registry.gauge(
+            "replay/occupancy",
+            "filled fraction of the device replay slab",
+            fn=lambda: ((buf._host_filled / buf.capacity)
+                        if (buf := self_ref()) is not None else 0.0))
+        self._h_insert = registry.histogram(
+            "replay/insert_s",
+            "host dispatch seconds of the jitted slab insert")
+        self._h_sample = registry.histogram(
+            "replay/sample_s",
+            "host dispatch seconds of the jitted slab sample (+unpack)")
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        """Valid slots (host mirror; exact — inserts are host-dispatched)."""
+        return self._host_filled
+
+    # -- lazy construction -------------------------------------------------
+
+    def _ensure(self, tree) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self._slabs is not None:
+            return
+        leaves, self._treedef = tree_flatten_with_none(tree)
+        self._shardings = [None if leaf is None else _slab_sharding(leaf)
+                           for leaf in leaves]
+        slabs = []
+        for leaf, sharding in zip(leaves, self._shardings):
+            if leaf is None:
+                slabs.append(None)
+                continue
+            slab = jnp.zeros((self.capacity,) + tuple(leaf.shape),
+                             leaf.dtype)
+            if sharding is not None:
+                slab = jax.device_put(slab, sharding)
+            slabs.append(slab)
+        self._slabs = slabs
+        self._cursor = jnp.zeros((), jnp.int32)
+        self._filled = jnp.zeros((), jnp.int32)
+        self._counter = jnp.zeros((), jnp.int32)
+        shardings = self._shardings
+        capacity = self.capacity
+        seed = self._seed
+
+        def insert(slabs, cursor, filled, leaves):
+            out = []
+            for slab, leaf, sharding in zip(slabs, leaves, shardings):
+                if slab is None:
+                    out.append(None)
+                    continue
+                updated = lax.dynamic_update_slice(
+                    slab, leaf[None], (cursor,) + (0,) * leaf.ndim)
+                if sharding is not None:
+                    updated = lax.with_sharding_constraint(
+                        updated, sharding)
+                out.append(updated)
+            return (out, (cursor + 1) % capacity,
+                    jnp.minimum(filled + 1, capacity))
+
+        def sample(slabs, filled, counter):
+            slot = _slot_index(seed, counter, filled)
+            out = []
+            for slab, sharding in zip(slabs, shardings):
+                if slab is None:
+                    out.append(None)
+                    continue
+                row = lax.dynamic_slice(
+                    slab, (slot,) + (0,) * (slab.ndim - 1),
+                    (1,) + slab.shape[1:])
+                row = row.reshape(slab.shape[1:])
+                if sharding is not None:
+                    row = lax.with_sharding_constraint(
+                        row, _row_sharding(sharding))
+                out.append(row)
+            return out, counter + 1
+
+        # Slabs and ring scalars are DONATED: the store advances in
+        # place on device, holding exactly one slab's worth of HBM.
+        self._insert_jit = jax.jit(insert, donate_argnums=(0, 1, 2))
+        self._sample_jit = jax.jit(sample, donate_argnums=(2,))
+
+    # -- the two operations ------------------------------------------------
+
+    def insert(self, tree, birth_us: Optional[int] = None) -> None:
+        """Store one device-resident tree (a packed upload buffer or a
+        Trajectory pytree) into the next ring slot.  ``birth_us`` is
+        the batch's unroll-birth stamp (ledger clock) for staleness
+        attribution; defaults to now."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._ensure(tree)
+            leaves, treedef = tree_flatten_with_none(tree)
+            if treedef != self._treedef:
+                raise ValueError(
+                    "inserted tree structure does not match the replay "
+                    "slab layout")
+            self._slabs, self._cursor, self._filled = self._insert_jit(
+                self._slabs, self._cursor, self._filled, leaves)
+            self._slot_birth_us[self._host_cursor] = (
+                int(birth_us) if birth_us is not None else now_us())
+            self._host_cursor = (self._host_cursor + 1) % self.capacity
+            self._host_filled = min(self._host_filled + 1, self.capacity)
+        dt = time.perf_counter() - t0
+        self._c_inserts.inc()
+        self._h_insert.observe(dt)
+        get_ledger().note_service("replay_insert", 1, dt)
+
+    def sample(self):
+        """One uniformly sampled stored tree, postprocessed to a
+        Trajectory — dispatch only, zero host sync.  Raises when the
+        buffer is empty (the driver's insert-before-sample ordering
+        makes that unreachable in the training loop)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._host_filled < 1:
+                raise RuntimeError(
+                    "replay sample from an empty buffer (insert at "
+                    "least one batch first)")
+            leaves, self._counter = self._sample_jit(
+                self._slabs, self._filled, self._counter)
+            counter, filled = self._host_counter, self._host_filled
+            self._host_counter += 1
+            # Snapshot the birth stamps INSIDE the lock: the device
+            # gather was dispatched under this lock, so the stamps as
+            # of now are the ones its slots held — a concurrent insert
+            # landing after release must not relabel the sampled
+            # slot's age with the NEW batch's birth.
+            births = tuple(self._slot_birth_us)
+        tree = tree_unflatten(self._treedef, leaves)
+        if self._postprocess is not None:
+            tree = self._postprocess(tree)
+        injector = get_fault_injector()
+        if injector.active and injector.should_fire("replay_corrupt"):
+            # Chaos (runtime/faults.py): poison the sampled batch's
+            # rewards with NaN — the learner's non-finite guard must
+            # absorb the replayed update as a bit-exact no-op and the
+            # skip counter must attribute it.
+            import jax.numpy as jnp
+
+            tree = tree._replace(
+                env_outputs=tree.env_outputs._replace(
+                    reward=tree.env_outputs.reward
+                    * jnp.float32(float("nan"))))
+        dt = time.perf_counter() - t0
+        self._c_samples.inc()
+        self._h_sample.observe(dt)
+        ledger = get_ledger()
+        ledger.note_service("replay_sample", 1, dt)
+        slot = self._mirror_slot(counter, filled)
+        if slot is not None:
+            age_s = max(0.0, (now_us() - births[slot]) / 1e6)
+            ledger.observe_replay_staleness(age_s)
+        return tree
+
+    # -- staleness mirror --------------------------------------------------
+
+    def _mirror_slot(self, counter: int, filled: int) -> Optional[int]:
+        """Replay the device's slot draw on the CPU backend: threefry
+        is backend-independent, so the same (seed, counter, filled)
+        yields the SAME slot the device gathered — staleness
+        attribution without touching the accelerator.  Best-effort:
+        None (skip the observation) if the CPU backend is unavailable."""
+        import jax
+
+        try:
+            # The mirror is host-local CPU work by construction; exempt
+            # it from a caller's transfer guard (the guard exists to
+            # catch ACCELERATOR transfers).
+            with jax.transfer_guard("allow"):
+                cpu = jax.local_devices(backend="cpu")[0]
+                with jax.default_device(cpu):
+                    return int(_slot_index(self._seed, counter, filled))
+        except Exception:
+            return None
+
+
+def _slot_index(seed: int, counter, filled):
+    """THE slot draw — one definition shared by the jitted device
+    sample and the host-side CPU mirror, so the two can never diverge:
+    uniform over [0, filled) keyed on fold_in(key(seed), counter)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.fold_in(jax.random.key(seed), counter)
+    return jax.random.randint(key, (), 0, jnp.maximum(filled, 1))
+
+
+def _row_sharding(slab_sharding):
+    """A sampled row's sharding: the slab spec minus the slot axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(slab_sharding.mesh,
+                         PartitionSpec(*slab_sharding.spec[1:]))

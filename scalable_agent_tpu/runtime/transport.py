@@ -385,6 +385,11 @@ class PackedTransport:
         self._upload_done: List[Optional[object]] = [None, None]
         self._slot = 0
         self._lock = threading.Lock()
+        # Replay tap (runtime/replay.py): called with each batch's
+        # UPLOADED device buffer — the replay slab's insert rides the
+        # one H2D copy the transport already paid, so feeding replay
+        # costs a device-side slab write and nothing on the wire.
+        self._upload_sink = None
         self._local_shards = self._num_shards // jax.process_count()
         if self._num_shards % jax.process_count():
             raise ValueError(
@@ -507,6 +512,12 @@ class PackedTransport:
         """Jitted bitcast+slice+reshape back to the Trajectory pytree."""
         return self._unpack_jit(device_buf)
 
+    def set_upload_sink(self, sink) -> None:
+        """Tap every uploaded device buffer (the replay insert path).
+        ``sink(device_buf)`` runs on the putting thread right after the
+        upload dispatch; None disconnects."""
+        self._upload_sink = sink
+
     # -- public API --------------------------------------------------------
 
     def put(self, trajectory):
@@ -530,6 +541,11 @@ class PackedTransport:
                 self._h_upload.time():
             device_buf = self.upload(buf)
         ledger.stamp_current("transport_upload")
+        if self._upload_sink is not None:
+            # The batch's bytes are on device now; the replay slab
+            # insert is a jitted device-side write of THIS buffer — no
+            # second copy ever crosses the link.
+            self._upload_sink(device_buf)
         with tracer.span("transport/unpack", cat="h2d"), \
                 self._h_unpack.time():
             result = self.unpack(device_buf)

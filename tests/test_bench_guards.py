@@ -432,6 +432,90 @@ class TestResilienceRegressionGuard:
         assert any("skipped update" in w for w in diag["warnings"])
 
 
+class TestReplayRegressionGuard:
+    """ISSUE 13 satellite: the replay guard's three arms — slab
+    overhead budget (<5% of the update stage) and the sampled-fps
+    floor (>= 0.95x fresh) bind on TPU and downgrade to advisory on
+    the CPU fallback; curve divergence at R <= 2 vs the R=0 anchor
+    binds EVERYWHERE (learning dynamics get no CPU excuse)."""
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {
+            "errors": [], "platform": platform,
+            "replay_insert_us": 50.0, "replay_sample_us": 80.0,
+            "replay_fresh_update_fps": 50000.0,
+            "replay_sampled_update_fps": 49500.0,
+            "replay_overhead_frac_on_update": 0.004,
+            "replay_sampled_vs_fresh_fps": 0.99,
+            "replay_ratio_curve": [
+                [0, 12.0, -1.5], [1, 11.5, -1.4],
+                [2, 11.0, -1.2], [4, 10.0, -1.0]],
+        }
+        diag.update(kwargs)
+        return diag
+
+    def test_healthy_run_is_silent(self):
+        diag = self._diag()
+        bench.replay_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_overhead_over_budget_fails_on_tpu(self):
+        diag = self._diag(replay_overhead_frac_on_update=0.08)
+        bench.replay_regression_guard(diag)
+        assert any("REPLAY" in e and "overhead" in e
+                   for e in diag["errors"])
+
+    def test_overhead_over_budget_warns_on_cpu_fallback(self):
+        diag = self._diag(platform="cpu",
+                          replay_overhead_frac_on_update=0.08)
+        bench.replay_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("REPLAY" in w for w in diag["warnings"])
+
+    def test_sampled_fps_below_floor_fails_on_tpu(self):
+        diag = self._diag(replay_sampled_vs_fresh_fps=0.9)
+        bench.replay_regression_guard(diag)
+        assert any("sampled-update fps" in e for e in diag["errors"])
+
+    def test_sampled_fps_below_floor_warns_on_cpu_fallback(self):
+        diag = self._diag(platform="cpu",
+                          replay_sampled_vs_fresh_fps=0.9)
+        bench.replay_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("sampled-update fps" in w for w in diag["warnings"])
+
+    def test_curve_divergence_at_low_ratio_fails_everywhere(self):
+        for platform in ("tpu", "cpu"):
+            diag = self._diag(platform=platform, replay_ratio_curve=[
+                [0, 12.0, -1.5], [2, 4.0, -1.2]])
+            bench.replay_regression_guard(diag)
+            assert any("algorithmic regression" in e
+                       for e in diag["errors"]), platform
+
+    def test_curve_divergence_at_high_ratio_is_advisory(self):
+        diag = self._diag(replay_ratio_curve=[
+            [0, 12.0, -1.5], [2, 11.0, -1.2], [4, 4.0, -1.0]])
+        bench.replay_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("R>2: advisory" in w for w in diag["warnings"])
+
+    def test_nonfinite_loss_fails(self):
+        diag = self._diag(replay_ratio_curve=[
+            [0, 12.0, -1.5], [1, 11.0, float("nan")]])
+        bench.replay_regression_guard(diag)
+        assert any("non-finite" in e for e in diag["errors"])
+
+    def test_missing_anchor_is_flagged(self):
+        diag = self._diag(replay_ratio_curve=[[2, 11.0, -1.2]])
+        bench.replay_regression_guard(diag)
+        assert any("anchor" in e for e in diag["errors"])
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.replay_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+
 class TestLedgerRegressionGuard:
     """ISSUE 8 satellite: the pipeline-ledger budget guard (<2% of the
     update stage, bench_ledger) fails on TPU, warns on the CPU

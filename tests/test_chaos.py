@@ -196,6 +196,43 @@ class TestNonFiniteGuard:
         state, m = learner.update(state, learner.put_trajectory(traj))
         assert float(np.asarray(m["update_skipped"])) == 1.0
 
+    def test_replay_corrupt_is_absorbed_as_noop_and_attributed(
+            self, learner_setup):
+        """ISSUE 13 satellite: the ``replay_corrupt`` chaos point
+        (runtime/replay.py) poisons one SAMPLED batch's rewards with
+        NaN — the fused non-finite guard must absorb the replayed
+        update as a bit-exact no-op (params/opt_state held, env_frames
+        held because the update is replayed) and the skip counter must
+        attribute it."""
+        from scalable_agent_tpu.runtime import DeviceReplayBuffer
+
+        learner, traj = learner_setup
+        state = learner.init(jax.random.key(3), traj)
+        state, m = learner.update(state, learner.put_trajectory(traj))
+        assert float(np.asarray(m["update_skipped"])) == 0.0
+        params_before = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).copy(), state.params)
+        frames_before = float(np.asarray(state.env_frames))
+
+        replay = DeviceReplayBuffer(2, seed=0)
+        replay.insert(learner.put_trajectory(traj))
+        configure_faults("replay_corrupt@2")
+        clean = replay.sample()    # occurrence 1: not armed
+        assert np.all(np.isfinite(np.asarray(clean.env_outputs.reward)))
+        poisoned = replay.sample()  # occurrence 2: fires
+        assert not np.all(np.isfinite(
+            np.asarray(poisoned.env_outputs.reward)))
+
+        state, m = learner.update(state, poisoned, fresh=False)
+        assert float(np.asarray(m["update_skipped"])) == 1.0
+        assert float(np.asarray(m["nonfinite_streak"])) == 1.0
+        for before, after in zip(
+                jax.tree_util.tree_leaves(params_before),
+                jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(before, np.asarray(after))
+        # Replayed: the frame counter is held even on the skip path.
+        assert float(np.asarray(state.env_frames)) == frames_before
+
     def test_guard_can_be_disabled(self, learner_setup):
         _, traj = learner_setup
         agent = ImpalaAgent(num_actions=NUM_ACTIONS)

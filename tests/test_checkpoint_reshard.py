@@ -65,12 +65,12 @@ def zero_trajectory(agent, batch=B):
     )
 
 
-def make_learner(agent, data):
+def make_learner(agent, data, **kwargs):
     mesh = make_mesh(MeshSpec(data=data, model=1),
                      devices=jax.devices()[:data])
     return Learner(agent, LearnerHyperparams(
         total_environment_frames=1e6), mesh,
-        frames_per_update=T_PLUS_1 * B)
+        frames_per_update=T_PLUS_1 * B, **kwargs)
 
 
 def host_tree(state):
@@ -127,6 +127,126 @@ def test_restore_across_shard_counts_is_bit_exact(
         # topology alone cannot trigger it — the detection path has
         # its own test below).
         assert ckpt2.verify_after_reshard(3, placed, force=True)
+    finally:
+        ckpt2.close()
+
+
+@pytest.mark.parametrize("save_data,restore_data", [(4, 2)])
+def test_impact_restore_across_shard_counts_is_bit_exact(
+        tmp_path, agent, save_data, restore_data):
+    """ISSUE 13 satellite: an ``--loss=impact`` run's TrainState (the
+    target network riding in ``target_params``) round-trips a topology
+    change bit-exactly, manifest verified after the reshard."""
+    logdir = str(tmp_path / "impact_reshard")
+    saver = make_learner(agent, save_data, loss="impact")
+    state = saver.init(jax.random.key(7), zero_trajectory(agent),
+                       env_frames=480.0)
+    # Move the online params away from the target so the round trip
+    # proves the TWO trees restore independently (a fresh init has
+    # target == params, which would hide a crossed-wire restore).
+    state, _ = saver.update(
+        state, saver.put_trajectory(zero_trajectory(agent)))
+    assert state.target_params is not None
+    saved_host = host_tree(state)
+    ckpt = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        assert ckpt.maybe_save(4, state, force=True)
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+    restorer = make_learner(agent, restore_data, loss="impact")
+    template = restorer.init(jax.random.key(0), zero_trajectory(agent))
+    ckpt2 = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        restored = ckpt2.restore(target=template)
+        assert restored is not None
+        step, host_state = restored
+        assert step == 4
+        placed = restorer.place_state(host_state)
+        for leaf in jax.tree_util.tree_leaves(placed):
+            assert leaf.sharding.mesh.devices.size == restore_data
+        assert_trees_bit_exact(host_tree(placed), saved_host)
+        # The target net specifically survived — and is NOT the online
+        # params (the update above moved them apart).
+        assert_trees_bit_exact(host_tree(placed.target_params),
+                               host_tree(state.target_params))
+        different = any(
+            not np.array_equal(np.asarray(p), np.asarray(t))
+            for p, t in zip(
+                jax.tree_util.tree_leaves(placed.params),
+                jax.tree_util.tree_leaves(placed.target_params)))
+        assert different
+        assert ckpt2.verify_after_reshard(4, placed, force=True)
+    finally:
+        ckpt2.close()
+
+
+def test_pre_impact_checkpoint_initializes_target_from_online(
+        tmp_path, agent):
+    """Checkpoint migration (the PR 4 legacy-retry pattern): a
+    ``--loss=vtrace`` checkpoint (target_params=None on disk) restored
+    into an ``--loss=impact`` run comes up with the target network
+    initialized from the restored ONLINE params at place_state time."""
+    logdir = str(tmp_path / "vtrace_to_impact")
+    saver = make_learner(agent, 2)            # vtrace: no target net
+    state = saver.init(jax.random.key(5), zero_trajectory(agent),
+                       env_frames=96.0)
+    assert state.target_params is None
+    saved_params = host_tree(state.params)
+    ckpt = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        assert ckpt.maybe_save(2, state, force=True)
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+    impact_learner = make_learner(agent, 2, loss="impact")
+    template = impact_learner.init(jax.random.key(0),
+                                   zero_trajectory(agent))
+    assert template.target_params is not None
+    ckpt2 = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        restored = ckpt2.restore(target=template)
+        assert restored is not None
+        _, host_state = restored
+        placed = impact_learner.place_state(host_state)
+        # The migrated target net IS the restored online params.
+        assert placed.target_params is not None
+        assert_trees_bit_exact(host_tree(placed.params), saved_params)
+        assert_trees_bit_exact(host_tree(placed.target_params),
+                               saved_params)
+        assert float(np.asarray(placed.env_frames)) == 96.0
+    finally:
+        ckpt2.close()
+
+
+def test_impact_checkpoint_restores_into_vtrace_run(tmp_path, agent):
+    """The reverse crossing: an ``--loss=impact`` checkpoint restored
+    under ``--loss=vtrace`` carries the target net through untouched
+    (the vtrace update ignores it) — nothing is silently dropped."""
+    logdir = str(tmp_path / "impact_to_vtrace")
+    saver = make_learner(agent, 2, loss="impact")
+    state = saver.init(jax.random.key(6), zero_trajectory(agent))
+    saved_target = host_tree(state.target_params)
+    ckpt = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        assert ckpt.maybe_save(3, state, force=True)
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+    vtrace_learner = make_learner(agent, 2)
+    template = vtrace_learner.init(jax.random.key(0),
+                                   zero_trajectory(agent))
+    assert template.target_params is None
+    ckpt2 = CheckpointManager(logdir, interval_s=1e9, keep=3)
+    try:
+        restored = ckpt2.restore(target=template)
+        assert restored is not None
+        _, host_state = restored
+        assert host_state.target_params is not None
+        assert_trees_bit_exact(host_state.target_params, saved_target)
     finally:
         ckpt2.close()
 

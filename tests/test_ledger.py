@@ -547,6 +547,90 @@ class TestReportCli:
         assert report.main([str(tmp_path)]) == 1
         assert "no metrics" in capsys.readouterr().out
 
+    def _append_replay_series(self, logdir, replayed_p95):
+        with open(os.path.join(logdir, "metrics.prom"), "a") as f:
+            f.write("\n".join([
+                "# TYPE impala_ledger_staleness_replayed_s summary",
+                'impala_ledger_staleness_replayed_s{quantile="0.5"} '
+                f"{replayed_p95 * 0.8}",
+                'impala_ledger_staleness_replayed_s{quantile="0.95"} '
+                f"{replayed_p95}",
+                'impala_ledger_staleness_replayed_s{quantile="0.99"} '
+                f"{replayed_p95 * 1.1}",
+                "# TYPE impala_replay_occupancy gauge",
+                "impala_replay_occupancy 0.5",
+                "# TYPE impala_replay_insert_total counter",
+                "impala_replay_insert_total 40.0",
+                "# TYPE impala_replay_sampled_total counter",
+                "impala_replay_sampled_total 80.0",
+                "# TYPE impala_replay_target_update_interval gauge",
+                "impala_replay_target_update_interval 100.0",
+            ]) + "\n")
+
+    def test_report_renders_staleness_split_and_replay(self, tmp_path,
+                                                      capsys):
+        """ISSUE 13 satellite: fresh vs replayed staleness render as
+        two series, the slab counters show, and a replayed p95 INSIDE
+        the IMPACT clip's useful range draws no recommendation."""
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_prom(logdir)
+        # Useful range = interval 100 / device rate 4.0 = 25s.
+        self._append_replay_series(logdir, replayed_p95=5.0)
+        assert report.main([logdir]) == 0
+        out = capsys.readouterr().out
+        assert "staleness (FRESH frame age" in out
+        assert "staleness (REPLAYED frame age" in out
+        assert "p95 5.000s" in out
+        assert "replay slab: occupancy 0.50, 40 inserted, 80 sampled" \
+            in out
+        assert "replay recommendation:" not in out
+
+    def test_report_recommends_when_replayed_staleness_exceeds_clip(
+            self, tmp_path, capsys):
+        """The dial's warning light: replayed p95 beyond ~one target
+        refresh period (target_update_interval / update rate) means
+        the sampled data predates the clip's anchor — the report must
+        say so and name the knobs."""
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_prom(logdir)
+        self._append_replay_series(logdir, replayed_p95=60.0)
+        assert report.main([logdir]) == 0
+        out = capsys.readouterr().out
+        assert "replay recommendation:" in out
+        assert "exceeds the IMPACT clip's useful range" in out
+        assert "--replay_ratio" in out
+
+    def test_report_without_replay_is_unchanged(self, tmp_path, capsys):
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_prom(logdir)
+        assert report.main([logdir]) == 0
+        out = capsys.readouterr().out
+        assert "REPLAYED" not in out
+        assert "replay slab:" not in out
+
+    def test_impact_without_replay_draws_no_slab_section(
+            self, tmp_path, capsys):
+        """--loss=impact publishes the anchor-cadence gauge even with
+        replay off — the report must not render a phantom slab."""
+        from scalable_agent_tpu.obs import report
+
+        logdir = str(tmp_path / "run")
+        self._write_prom(logdir)
+        with open(os.path.join(logdir, "metrics.prom"), "a") as f:
+            f.write(
+                "# TYPE impala_replay_target_update_interval gauge\n"
+                "impala_replay_target_update_interval 100.0\n")
+        assert report.main([logdir]) == 0
+        out = capsys.readouterr().out
+        assert "replay slab:" not in out
+        assert "replay recommendation:" not in out
+
 
 # ---------------------------------------------------------------------------
 # Tier-1 driver smoke (ISSUE 8 acceptance): a single-chip traced run
@@ -646,4 +730,4 @@ def test_traced_driver_run_emits_complete_ledger(tmp_path, monkeypatch,
     assert (f"dominant stage: {expected_dominant} "
             f"({shares[expected_dominant]:.0%} of frame latency") in out
     assert "top recommendation:" in out
-    assert "staleness (frame age at consumption):" in out
+    assert "staleness (FRESH frame age at consumption):" in out
