@@ -25,6 +25,8 @@ script ALWAYS prints exactly one JSON line
 even when every stage fails.
 """
 
+import argparse
+import collections
 import functools
 import json
 import math
@@ -1588,11 +1590,7 @@ def service_regression_guard(diag, bench_dir=None):
             f"{SERVICE_GUARD_MIN_RATIO:.1f}x; service "
             f"{diag.get('service_env_frames_per_sec')} vs grouped "
             f"{diag.get('grouped_env_frames_per_sec')} env_frames/s)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg)
     prev, ref_name = _latest_bench_artifact(diag, bench_dir)
     if not prev or prev.get("platform") != diag.get("platform"):
         return
@@ -1840,14 +1838,9 @@ def replay_regression_guard(diag):
     or when the loss-vs-replay-ratio curve shows an R <= 2 arm
     diverging from the R=0 anchor (binding EVERYWHERE — learning
     dynamics, unlike timings, do not get a CPU excuse)."""
-    cpu = diag.get("platform") == "cpu"
 
     def flag(message):
-        if cpu:
-            diag.setdefault("warnings", []).append(
-                message + " — CPU fallback: advisory")
-        else:
-            diag["errors"].append(message)
+        guard_flag(diag, message)
 
     frac = diag.get("replay_overhead_frac_on_update")
     if frac is not None and frac > REPLAY_BUDGET_FRAC:
@@ -2123,12 +2116,9 @@ def resilience_regression_guard(diag):
             f"budget (guarded "
             f"{diag.get('resilience_guarded_sec_per_update')}s vs plain "
             f"{diag.get('resilience_plain_sec_per_update')}s)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory, host-compile jitter "
-                "exceeds the budget's resolution")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, "
+                   "host-compile jitter exceeds the budget's resolution")
     ratio = diag.get("resilience_skip_vs_normal")
     if ratio is not None and ratio > 1.5:
         diag.setdefault("warnings", []).append(
@@ -2159,12 +2149,9 @@ def fleet_regression_guard(diag):
             f"(publish {diag.get('fleet_heartbeat_publish_us')}us, "
             f"monitor {diag.get('fleet_monitor_pass_us')}us, guard "
             f"{diag.get('fleet_collective_guard_us')}us)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory, the tiny "
-                "sec_per_update makes the ratio jitter-bound")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, the tiny "
+                   "sec_per_update makes the ratio jitter-bound")
 
 
 # The pipeline ledger's budget on the update stage (ISSUE 8
@@ -2200,12 +2187,9 @@ def ledger_regression_guard(diag, bench_dir=None):
             f"bind/lookup {diag.get('ledger_bind_lookup_us')}us, "
             f"publish/record "
             f"{diag.get('ledger_publish_us_per_record')}us)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory, the tiny "
-                "sec_per_update makes the ratio jitter-bound")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, the tiny "
+                   "sec_per_update makes the ratio jitter-bound")
     prev, ref_name = _latest_bench_artifact(diag, bench_dir)
     if not prev or prev.get("platform") != diag.get("platform"):
         return
@@ -2243,11 +2227,7 @@ def elastic_regression_guard(diag):
             f"ELASTIC: supervisor watch-cycle overhead {frac:.3%} "
             f"exceeds the {ELASTIC_BUDGET_FRAC:.1%} budget "
             f"(cycle {diag.get('elastic_watch_cycle_us')}us)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg)
     mttr = diag.get("elastic_mttr_s")
     if mttr is not None and mttr > ELASTIC_MTTR_ADVISORY_S:
         diag.setdefault("warnings", []).append(
@@ -2298,12 +2278,9 @@ def devtel_regression_guard(diag, bench_dir=None):
             f"(accumulate {diag.get('devtel_accumulate_us')}us, fetch "
             f"{diag.get('devtel_fetch_us')}us, publish "
             f"{diag.get('devtel_publish_us')}us)")
-        if diag.get("platform") == "cpu":
-            diag.setdefault("warnings", []).append(
-                msg + " — CPU fallback: advisory, the tiny "
-                "sec_per_update makes the ratio jitter-bound")
-        else:
-            diag["errors"].append(msg)
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, the tiny "
+                   "sec_per_update makes the ratio jitter-bound")
     prev, ref_name = _latest_bench_artifact(diag, bench_dir)
     if not prev or prev.get("platform") != diag.get("platform"):
         return
@@ -2321,8 +2298,6 @@ def devtel_regression_guard(diag, bench_dir=None):
 KERNEL_GUARD_TOL_US = 2.0
 KERNEL_GUARD_TOL_MFU = 0.5
 
-_KERNEL_KEY_RE = None  # compiled lazily (re import stays local)
-
 
 def kernel_regression_guard(diag, bench_dir=None):
     """ISSUE 12: any NAMED kernel regressing vs the newest committed
@@ -2332,27 +2307,18 @@ def kernel_regression_guard(diag, bench_dir=None):
     under a key rename); slower than ``KERNEL_GUARD_TOL_US``x or below
     ``KERNEL_GUARD_TOL_MFU``x MFU -> error on TPU, advisory on the CPU
     fallback (kernel micro-timings there measure host scheduling)."""
-    import re
+    from scalable_agent_tpu.obs.kernels import BENCH_KERNEL_KEY_RE
 
-    global _KERNEL_KEY_RE
-    if _KERNEL_KEY_RE is None:
-        _KERNEL_KEY_RE = re.compile(
-            r"^kernel_(?P<name>.+)_(?P<kind>us|mfu)$")
     prev, ref_name = _latest_bench_artifact(diag, bench_dir)
     if not prev or prev.get("platform") != diag.get("platform"):
         return
-    hard = diag.get("platform") == "tpu"
 
     def flag(message):
-        if hard:
-            diag["errors"].append(message)
-        else:
-            diag.setdefault("warnings", []).append(
-                message + " — CPU fallback: advisory")
+        guard_flag(diag, message)
 
     compared = []
     for key in sorted(prev):
-        match = _KERNEL_KEY_RE.match(key)
+        match = BENCH_KERNEL_KEY_RE.match(key)
         if not match:
             continue
         old = prev.get(key)
@@ -2399,13 +2365,9 @@ def transport_regression_guard(diag, bench_dir=None):
     overlap = diag.get("transport_overlap_frac")
     if speedup is None and overlap is None:
         return  # stage didn't run (and no artifact says it should have)
-    hard = diag.get("platform") == "tpu"
 
     def flag(message):
-        if hard:
-            diag["errors"].append(message)
-        else:
-            diag.setdefault("warnings", []).append(message)
+        guard_flag(diag, message)
 
     if speedup is not None and speedup < 1.0:
         flag(f"TRANSPORT REGRESSION: packed upload is SLOWER than "
@@ -2509,56 +2471,43 @@ def maybe_retry_e2e(diag, start_monotonic, deadline):
 
 
 _BENCH_ARTIFACT_CACHE = {}
+# Artifact basenames the guards must NOT compare against — set by
+# run_guards for the orchestrator's subset re-runs, where the newest
+# BENCH_r*.json on disk is the round artifact being merged onto (a
+# guard comparing the round to itself would silently disarm every
+# cross-round check).
+_GUARD_ARTIFACT_EXCLUDE = frozenset()
 
 
 def _latest_bench_artifact(diag, bench_dir=None):
-    """The newest committed BENCH_r*.json parsed to the bench's own dict
-    (handles the raw JSON line, the driver's {"parsed": ...} wrapper,
-    and the older tail-embedded format).  Returns (dict|None, name).
-    Cached per directory: both guards run back-to-back in main(), and a
-    corrupt artifact must be read (and reported) once, not twice."""
-    import glob
+    """The newest committed BENCH_r*.json parsed to the bench's own
+    dict, through the SHARED discovery/parse helper in obs/rounds.py
+    (also behind ``rounds report|validate`` and obs/report.py's
+    bench-kernel section): handles the raw JSON line, the driver's
+    {"parsed": ...} wrapper, the tail-embedded format, a TRUNCATED
+    tail via regex salvage, and the round orchestrator's schema-v1
+    artifacts — one parser, so the guards and the trajectory can never
+    drift.  Returns (dict|None, name).  Cached per directory: every
+    guard runs back-to-back in main(), and a corrupt artifact must be
+    read (and reported) once, not twice."""
+    from scalable_agent_tpu.obs.rounds import newest_artifact
 
     bench_dir = os.path.abspath(
         bench_dir or os.path.dirname(os.path.abspath(__file__)))
-    if bench_dir in _BENCH_ARTIFACT_CACHE:
-        return _BENCH_ARTIFACT_CACHE[bench_dir]
-    # The r-pattern, specifically: a stray BENCH_summary.json etc.
-    # would sort last, parse to nothing, and silently disarm BOTH
-    # regression guards.
-    files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
-    if not files:
-        _BENCH_ARTIFACT_CACHE[bench_dir] = (None, None)
+    cache_key = (bench_dir, _GUARD_ARTIFACT_EXCLUDE)
+    if cache_key in _BENCH_ARTIFACT_CACHE:
+        return _BENCH_ARTIFACT_CACHE[cache_key]
+    parsed = newest_artifact(bench_dir,
+                             exclude_names=_GUARD_ARTIFACT_EXCLUDE)
+    if parsed is None:
+        _BENCH_ARTIFACT_CACHE[cache_key] = (None, None)
         return None, None
-    path = files[-1]
-    try:
-        raw = json.load(open(path))
-    except Exception:
+    if parsed.kind == "invalid":
         diag["errors"].append(
-            f"regression guard: unreadable {os.path.basename(path)}")
-        _BENCH_ARTIFACT_CACHE[bench_dir] = (None, os.path.basename(path))
-        return None, os.path.basename(path)
-    prev = raw if isinstance(raw, dict) and "metric" in raw else None
-    if (prev is None and isinstance(raw, dict)
-            and isinstance(raw.get("parsed"), dict)
-            and "metric" in raw["parsed"]):
-        # Driver artifact format: the already-parsed bench dict.
-        prev = raw["parsed"]
-    if prev is None and isinstance(raw, dict) and "tail" in raw:
-        # Older driver artifacts: the bench JSON line inside `tail`
-        # (may be truncated mid-line — best effort).
-        for line in reversed(str(raw["tail"]).splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    cand = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "metric" in cand:
-                    prev = cand
-                    break
-    _BENCH_ARTIFACT_CACHE[bench_dir] = (prev, os.path.basename(path))
-    return prev, os.path.basename(path)
+            f"regression guard: unreadable {parsed.name}")
+    prev = parsed.metrics or None
+    _BENCH_ARTIFACT_CACHE[cache_key] = (prev, parsed.name)
+    return prev, parsed.name
 
 
 def regression_guard(result, diag, bench_dir=None):
@@ -2650,7 +2599,348 @@ def obs_regression_guard(diag, bench_dir=None):
         diag["obs_regression_keys"] = compared
 
 
-def main():
+# ---------------------------------------------------------------------------
+# The suite + guard registries: the ONE ordered list of what a bench
+# round runs, with per-suite subprocess timeouts for the round
+# orchestrator (`python -m scalable_agent_tpu.obs.rounds run` executes
+# each suite in its own process under its own timeout so a crashing or
+# hanging suite can't lose the round), and the single
+# binding-vs-advisory policy table every guard routes its breaches
+# through.  `python bench.py --list` prints both without importing jax.
+
+RunContext = collections.namedtuple(
+    "RunContext", "start_monotonic deadline")
+SuiteSpec = collections.namedtuple(
+    "SuiteSpec", "name run timeout_s description")
+GuardSpec = collections.namedtuple(
+    "GuardSpec", "name run policy description")
+
+
+def _suite_budget(diag, tpu_s, cpu_s):
+    return cpu_s if diag.get("platform") == "cpu" else tpu_s
+
+
+SUITE_REGISTRY = (
+    SuiteSpec("bench_link",
+              lambda result, diag, ctx: bench_link(diag), 420,
+              "host<->device link: per-call RTT + flat H2D bandwidth"),
+    SuiteSpec("bench_learner",
+              lambda result, diag, ctx: bench_learner(result, diag), 900,
+              "HEADLINE: steady-state jitted update fps/MFU "
+              "(T=100, B=32)"),
+    SuiteSpec("bench_end_to_end",
+              lambda result, diag, ctx: bench_end_to_end(
+                  result, diag,
+                  budget_s=_suite_budget(diag, 420.0, 15.0),
+                  platform=diag["platform"]), 1200,
+              "host-pipeline e2e fps through the real ActorPool + "
+              "prefetch"),
+    SuiteSpec("bench_ingraph",
+              lambda result, diag, ctx: bench_ingraph(
+                  diag, budget_s=_suite_budget(diag, 90.0, 15.0)), 600,
+              "fused in-graph rollout+update e2e fps (device-resident "
+              "env)"),
+    SuiteSpec("bench_learning",
+              lambda result, diag, ctx: bench_learning(
+                  diag, budget_s=_suite_budget(diag, 120.0, 90.0)), 600,
+              "learning proof on fake_bandit: return curve + verdict"),
+    SuiteSpec("bench_kernels",
+              lambda result, diag, ctx: bench_kernels(diag), 600,
+              "Pallas-vs-XLA v-trace/LSTM kernel micro-timings "
+              "(TPU only)"),
+    SuiteSpec("bench_convs",
+              lambda result, diag, ctx: bench_convs(diag), 900,
+              "per-layer conv gradient rooflines at B=256 (TPU only)"),
+    SuiteSpec("bench_roofline",
+              lambda result, diag, ctx: bench_roofline(diag), 900,
+              "update-stage decomposition: forward/loss/grad/optimizer "
+              "(TPU only)"),
+    SuiteSpec("bench_learner_b256",
+              lambda result, diag, ctx: bench_learner_b256(diag), 600,
+              "MXU-filling-batch diagnostic: the update at B=256 "
+              "(TPU only)"),
+    SuiteSpec("bench_obs",
+              lambda result, diag, ctx: bench_obs(diag), 300,
+              "obs primitive unit costs + overhead fraction on the "
+              "update"),
+    SuiteSpec("bench_ledger",
+              lambda result, diag, ctx: bench_ledger(diag), 300,
+              "pipeline-ledger stamp/lifecycle/publish unit costs"),
+    SuiteSpec("bench_devtel",
+              lambda result, diag, ctx: bench_devtel(diag), 420,
+              "device-telemetry accumulate/fetch/publish unit costs"),
+    SuiteSpec("bench_transport",
+              lambda result, diag, ctx: bench_transport(
+                  diag, budget_s=_suite_budget(diag, 150.0, 30.0)), 900,
+              "packed vs per-leaf H2D + in-flight overlap fraction"),
+    SuiteSpec("bench_actor_service",
+              lambda result, diag, ctx: bench_actor_service(
+                  diag, budget_s=_suite_budget(diag, 240.0, 60.0),
+                  platform=diag["platform"]), 900,
+              "continuous-batching service vs grouped pool e2e at "
+              "equal env count"),
+    SuiteSpec("bench_resilience",
+              lambda result, diag, ctx: bench_resilience(
+                  diag, budget_s=_suite_budget(diag, 90.0, 45.0)), 600,
+              "fused non-finite guard cost + NaN-skip path rate"),
+    SuiteSpec("bench_replay",
+              lambda result, diag, ctx: bench_replay(
+                  diag, budget_s=_suite_budget(diag, 300.0, 240.0)),
+              1200,
+              "replay slab unit costs, sampled-vs-fresh fps, "
+              "loss-vs-replay-ratio curve"),
+    SuiteSpec("bench_fleet",
+              lambda result, diag, ctx: bench_fleet(diag), 300,
+              "fleet fault-domain layer unit costs"),
+    SuiteSpec("bench_elastic",
+              lambda result, diag, ctx: bench_elastic(
+                  # The mini-reshard's workers always run on CPU (a TPU
+                  # bench host can't share its chips between concurrent
+                  # processes), so the budget is CPU-sized everywhere:
+                  # epoch 0's first compile to a durable checkpoint
+                  # (~60-90s) + the relaunched fleet's recovery (~95s
+                  # measured) must BOTH fit.
+                  diag, budget_s=300.0), 600,
+              "elastic supervisor watch-cycle cost + a real "
+              "2-process mini-reshard MTTR"),
+    SuiteSpec("e2e_link_retry",
+              lambda result, diag, ctx: maybe_retry_e2e(
+                  diag, ctx.start_monotonic, ctx.deadline), 900,
+              "link-gated e2e retry: re-run the e2e stage if the "
+              "tunnel recovers"),
+)
+
+# The one binding-vs-advisory policy table (previously implied by each
+# guard's inline platform checks): guard_flag() routes every breach
+# through it, --list prints it, and the round artifact's guard summary
+# records each guard's policy next to its outcome.
+GUARD_POLICIES = {
+    "binding": "a breach always fails the round (subject to the "
+               "guard's platform-comparability gate against the "
+               "previous artifact)",
+    "tpu_binding": "a breach fails the round on TPU and downgrades to "
+                   "a warning on the CPU fallback, where host "
+                   "scheduling dominates the measured ratios; a "
+                   "guarded key published last round but missing now "
+                   "ALWAYS fails",
+    "mixed": "throughput arms are tpu_binding; algorithmic arms "
+             "(learning-curve divergence at R<=2) bind everywhere — "
+             "learning dynamics get no CPU excuse",
+    "advisory": "never fails the round; warnings only",
+}
+
+
+def guard_flag(diag, message, policy="tpu_binding",
+               advisory_note=" — CPU fallback: advisory"):
+    """The ONE binding-vs-advisory decision for a guard breach.
+    ``binding`` appends to errors unconditionally; ``tpu_binding``
+    downgrades to a warning (with ``advisory_note`` explaining why)
+    when this round fell back to CPU; ``advisory`` always warns."""
+    cpu = diag.get("platform") == "cpu"
+    if policy == "binding" or (policy != "advisory" and not cpu):
+        diag["errors"].append(message)
+    else:
+        diag.setdefault("warnings", []).append(
+            message + (advisory_note if policy != "advisory" else ""))
+
+
+# NOTE: each guard's policy below DESCRIBES the routing its body
+# implements (directly or via guard_flag) — the per-guard CPU-advisory
+# tests in tests/test_bench_guards.py pin that the label and the
+# behavior agree; change them together.
+GUARD_REGISTRY = (
+    GuardSpec("regression_guard",
+              lambda result, diag, bench_dir: regression_guard(
+                  result, diag, bench_dir), "binding",
+              "headline learner/in-graph fps + MFU vs the newest "
+              "committed artifact"),
+    GuardSpec("obs_regression_guard",
+              lambda result, diag, bench_dir: obs_regression_guard(
+                  diag, bench_dir), "binding",
+              "obs primitive unit costs vs the newest artifact: >10% "
+              "warns, >2x fails"),
+    GuardSpec("ledger_regression_guard",
+              lambda result, diag, bench_dir: ledger_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "pipeline ledger < 2% of the update stage"),
+    GuardSpec("devtel_regression_guard",
+              lambda result, diag, bench_dir: devtel_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "device telemetry < 1% of the update stage"),
+    GuardSpec("kernel_regression_guard",
+              lambda result, diag, bench_dir: kernel_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "any named kernel 2x slower or MFU halved vs the newest "
+              "artifact"),
+    GuardSpec("transport_regression_guard",
+              lambda result, diag, bench_dir:
+              transport_regression_guard(diag, bench_dir),
+              "tpu_binding",
+              "packed H2D >= per-leaf; in-flight overlap >= 0.5"),
+    GuardSpec("service_regression_guard",
+              lambda result, diag, bench_dir: service_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "actor service >= 1.0x grouped at equal env count (r06 "
+              "target: >= 2x)"),
+    GuardSpec("resilience_regression_guard",
+              lambda result, diag, bench_dir:
+              resilience_regression_guard(diag), "tpu_binding",
+              "fused finite check < 1% of the update stage"),
+    GuardSpec("replay_regression_guard",
+              lambda result, diag, bench_dir: replay_regression_guard(
+                  diag), "mixed",
+              "replay slab < 5% + sampled fps >= 0.95x fresh (tpu); "
+              "R<=2 curve divergence binds everywhere"),
+    GuardSpec("fleet_regression_guard",
+              lambda result, diag, bench_dir: fleet_regression_guard(
+                  diag), "tpu_binding",
+              "fleet fault-domain layer < 0.5% of the update stage"),
+    GuardSpec("elastic_regression_guard",
+              lambda result, diag, bench_dir: elastic_regression_guard(
+                  diag), "tpu_binding",
+              "elastic supervisor < 0.5% of the update stage; MTTR "
+              "advisory everywhere"),
+)
+
+GUARDS_STAGE = "guards"
+
+
+def run_guards(result, diag, bench_dir=None, exclude=()):
+    """Run every registered guard over the (merged) round diag —
+    each under its own exception boundary — and record the single
+    end-of-round guard summary the round artifact carries: per guard,
+    its policy and whether it passed, warned, failed, or crashed.
+    ``exclude`` names artifact files the comparisons must skip (the
+    orchestrator excludes the round artifact being merged onto)."""
+    global _GUARD_ARTIFACT_EXCLUDE
+    _GUARD_ARTIFACT_EXCLUDE = frozenset(exclude)
+    try:
+        return _run_guards_inner(result, diag, bench_dir)
+    finally:
+        _GUARD_ARTIFACT_EXCLUDE = frozenset()
+
+
+def _run_guards_inner(result, diag, bench_dir):
+    summary = {}
+    for spec in GUARD_REGISTRY:
+        diag["stage"] = spec.name
+        errors_before = len(diag["errors"])
+        warnings_before = len(diag.get("warnings", []))
+        crashed = False
+        try:
+            spec.run(result, diag, bench_dir)
+        except Exception:
+            diag["errors"].append(
+                f"{spec.name} failed: " + traceback.format_exc(limit=2))
+            crashed = True
+        new_errors = len(diag["errors"]) - errors_before
+        new_warnings = len(diag.get("warnings", [])) - warnings_before
+        summary[spec.name] = {
+            "status": ("crashed" if crashed
+                       else "failed" if new_errors
+                       else "warned" if new_warnings else "ok"),
+            "policy": spec.policy,
+            "errors": new_errors,
+            "warnings": new_warnings,
+        }
+    diag["guard_summary"] = summary
+    return summary
+
+
+def _registry_payload():
+    return {
+        "suites": [{"name": spec.name, "timeout_s": spec.timeout_s,
+                    "description": spec.description}
+                   for spec in SUITE_REGISTRY],
+        "guards": [{"name": spec.name, "policy": spec.policy,
+                    "description": spec.description}
+                   for spec in GUARD_REGISTRY],
+        "policies": GUARD_POLICIES,
+    }
+
+
+def _print_registry(as_json):
+    if as_json:
+        print(json.dumps(_registry_payload()), flush=True)
+        return
+    print("bench suites (run a subset: --suites=a,b; orchestrated "
+          "round: python -m scalable_agent_tpu.obs.rounds run):")
+    for spec in SUITE_REGISTRY:
+        print(f"  {spec.name:<22} {spec.timeout_s:>5.0f}s  "
+              f"{spec.description}")
+    print("guards (run together as the final stage; alone: "
+          "--suites=guards):")
+    for spec in GUARD_REGISTRY:
+        print(f"  {spec.name:<28} [{spec.policy}]  {spec.description}")
+    print("guard policies:")
+    for name, text in GUARD_POLICIES.items():
+        print(f"  {name}: {text}")
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        description="IMPALA TPU benchmark.  With no flags, runs every "
+                    "suite then every guard and prints exactly one "
+                    "JSON result line (the historical contract).  The "
+                    "round orchestrator (python -m scalable_agent_tpu."
+                    "obs.rounds run) drives the per-suite flags.")
+    parser.add_argument("--list", action="store_true",
+                        help="print the suite/guard registry and exit "
+                             "(no jax import)")
+    parser.add_argument("--json", action="store_true",
+                        help="with --list: machine-readable registry")
+    parser.add_argument("--suites", default=None,
+                        help="comma-separated subset of suites to run "
+                             "('guards' = the guard stage)")
+    parser.add_argument("--context", default=None, metavar="JSON_FILE",
+                        help="seed the diag with a previous stage's "
+                             "merged metrics (the orchestrator's "
+                             "cross-suite hand-off)")
+    parser.add_argument("--json_out", default=None, metavar="PATH",
+                        help="ALSO write the result JSON line to PATH "
+                             "(atomic)")
+    parser.add_argument("--bench_dir", default=None, metavar="DIR",
+                        help="directory of committed BENCH_r*.json "
+                             "artifacts the regression guards compare "
+                             "against (default: bench.py's own "
+                             "directory)")
+    parser.add_argument("--guard_exclude", default=None,
+                        metavar="NAMES",
+                        help="comma-separated artifact filenames the "
+                             "guards must skip (the orchestrator "
+                             "excludes the round artifact being "
+                             "merged onto, so a subset re-run "
+                             "compares against the PREVIOUS round, "
+                             "not itself)")
+    parser.add_argument("--crash", default=None, metavar="SUITE",
+                        help="raise inside SUITE (stage-isolation "
+                             "testing)")
+    parser.add_argument("--crash_hard", default=None, metavar="SUITE",
+                        help="hard-exit the process inside SUITE "
+                             "(stage-isolation testing)")
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.list:
+        _print_registry(args.json)
+        return 0
+
+    suite_names = [spec.name for spec in SUITE_REGISTRY]
+    selected = None
+    guards_selected = True
+    if args.suites:
+        names = [name for name in args.suites.split(",") if name]
+        unknown = [name for name in names
+                   if name not in suite_names + [GUARDS_STAGE]]
+        if unknown:
+            print(f"unknown suites {unknown}; known: "
+                  f"{suite_names + [GUARDS_STAGE]}", file=sys.stderr)
+            return 2
+        selected = set(names)
+        guards_selected = GUARDS_STAGE in selected
+
     result = {
         "metric": "learner_env_frames_per_sec_per_chip",
         "value": 0.0,
@@ -2658,11 +2948,30 @@ def main():
         "vs_baseline": 0.0,
     }
     diag = {"errors": [], "stage": "probe"}
+    if args.context:
+        try:
+            context = json.load(open(args.context))
+        except (OSError, ValueError) as exc:
+            print(f"unreadable --context {args.context}: {exc}",
+                  file=sys.stderr)
+            return 2
+        for key in ("value", "vs_baseline"):
+            if isinstance(context.get(key), (int, float)):
+                result[key] = context[key]
+        diag.update({
+            key: value for key, value in context.items()
+            if key not in ("errors", "warnings", "stage",
+                           "guard_summary", "metric", "unit", "value",
+                           "vs_baseline")})
+        diag["errors"] = []
     start_monotonic = time.monotonic()
     deadline = start_monotonic + TOTAL_TIMEOUT_S
+    ctx = RunContext(start_monotonic, deadline)
 
     # Exactly-one-JSON-line contract: both the watchdog and the normal
-    # path funnel through this once-only emitter.
+    # path funnel through this once-only emitter.  --json_out gets the
+    # same line, written atomically, so the round orchestrator never
+    # has to scrape it out of a noisy stdout.
     emit_lock = threading.Lock()
     emitted = [False]
 
@@ -2672,7 +2981,16 @@ def main():
                 return
             emitted[0] = True
             result.update(diag)
-            print(json.dumps(result), flush=True)
+            line = json.dumps(result)
+            print(line, flush=True)
+            if args.json_out:
+                try:
+                    tmp = args.json_out + ".tmp"
+                    with open(tmp, "w") as handle:
+                        handle.write(line + "\n")
+                    os.replace(tmp, args.json_out)
+                except OSError:
+                    pass  # stdout still carries the line
 
     def watchdog():
         # Last-resort guarantee: the tunnel can hang in the MAIN process
@@ -2721,219 +3039,32 @@ def main():
     diag["n_devices"] = len(devices)
     diag["jax_version"] = jax.__version__
 
-    diag["stage"] = "bench_link"
-    try:
-        bench_link(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_link failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_learner"
-    try:
-        bench_learner(result, diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_learner failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_end_to_end"
-    try:
-        bench_end_to_end(
-            result, diag,
-            # 240s repeatedly landed 7-22 updates on the degraded r4
-            # link — below the 30-update floor; 420s reached it at the
-            # mid-range observed rates (run 8: exactly 30).  A
-            # worst-case window (run 4's 2.7k fps) would still fall
-            # short — the floor error then records that honestly.
-            budget_s=420.0 if diag["platform"] != "cpu" else 15.0,
-            platform=diag["platform"])
-    except Exception:
-        diag["errors"].append(
-            "bench_end_to_end failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_ingraph"
-    try:
-        bench_ingraph(
-            diag, budget_s=90.0 if diag["platform"] != "cpu" else 15.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_ingraph failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_learning"
-    try:
-        bench_learning(
-            diag, budget_s=120.0 if diag["platform"] != "cpu" else 90.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_learning failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_kernels"
-    try:
-        bench_kernels(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_kernels failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_convs"
-    try:
-        bench_convs(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_convs failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_roofline"
-    try:
-        bench_roofline(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_roofline failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_learner_b256"
-    try:
-        bench_learner_b256(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_learner_b256 failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_obs"
-    try:
-        bench_obs(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_obs failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_ledger"
-    try:
-        bench_ledger(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_ledger failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_devtel"
-    try:
-        bench_devtel(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_devtel failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_transport"
-    try:
-        bench_transport(
-            diag, budget_s=150.0 if diag["platform"] != "cpu" else 30.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_transport failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_actor_service"
-    try:
-        bench_actor_service(
-            diag, budget_s=240.0 if diag["platform"] != "cpu" else 60.0,
-            platform=diag["platform"])
-    except Exception:
-        diag["errors"].append(
-            "bench_actor_service failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_resilience"
-    try:
-        bench_resilience(
-            diag, budget_s=90.0 if diag["platform"] != "cpu" else 45.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_resilience failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_replay"
-    try:
-        bench_replay(
-            diag, budget_s=300.0 if diag["platform"] != "cpu" else 240.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_replay failed: " + traceback.format_exc(limit=3))
-    diag["stage"] = "bench_fleet"
-    try:
-        bench_fleet(diag)
-    except Exception:
-        diag["errors"].append(
-            "bench_fleet failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "bench_elastic"
-    try:
-        # The mini-reshard's workers always run on CPU (a TPU bench
-        # host can't share its chips between concurrent processes), so
-        # the budget is CPU-sized everywhere: epoch 0's first compile
-        # to a durable checkpoint (~60-90s) + the relaunched fleet's
-        # recovery (~95s measured) must BOTH fit.
-        bench_elastic(diag, budget_s=300.0)
-    except Exception:
-        diag["errors"].append(
-            "bench_elastic failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "e2e_link_retry"
-    try:
-        maybe_retry_e2e(diag, start_monotonic, deadline)
-    except Exception:
-        diag["errors"].append(
-            "e2e retry stage failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "regression_guard"
-    try:
-        regression_guard(result, diag)
-    except Exception:
-        diag["errors"].append(
-            "regression guard failed: " + traceback.format_exc(limit=2))
-    diag["stage"] = "obs_regression_guard"
-    try:
-        obs_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "obs regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "ledger_regression_guard"
-    try:
-        ledger_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "ledger regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "devtel_regression_guard"
-    try:
-        devtel_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "devtel regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "kernel_regression_guard"
-    try:
-        kernel_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "kernel regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "transport_regression_guard"
-    try:
-        transport_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "transport regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "service_regression_guard"
-    try:
-        service_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "service regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "resilience_regression_guard"
-    try:
-        resilience_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "resilience regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "replay_regression_guard"
-    try:
-        replay_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "replay regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "fleet_regression_guard"
-    try:
-        fleet_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "fleet regression guard failed: "
-            + traceback.format_exc(limit=2))
-    diag["stage"] = "elastic_regression_guard"
-    try:
-        elastic_regression_guard(diag)
-    except Exception:
-        diag["errors"].append(
-            "elastic regression guard failed: "
-            + traceback.format_exc(limit=2))
+    # Every selected suite runs under its own exception boundary (the
+    # registry replaces the old hand-rolled per-stage try blocks); the
+    # guards run together as one final stage over the full diag.
+    for spec in SUITE_REGISTRY:
+        if selected is not None and spec.name not in selected:
+            continue
+        diag["stage"] = spec.name
+        try:
+            if args.crash_hard == spec.name:
+                os._exit(41)
+            if args.crash == spec.name:
+                raise RuntimeError(
+                    f"injected crash in {spec.name} (--crash)")
+            spec.run(result, diag, ctx)
+        except Exception:
+            diag["errors"].append(
+                f"{spec.name} failed: " + traceback.format_exc(limit=3))
+    if guards_selected:
+        run_guards(result, diag, bench_dir=args.bench_dir,
+                   exclude=tuple(
+                       name for name in
+                       (args.guard_exclude or "").split(",") if name))
     diag["stage"] = "done"
     emit()
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -45,6 +45,8 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
+    "BENCH_KERNEL_KEY_RE",
+    "BENCH_KERNEL_SERIES_RE",
     "KERNELS_JSON_NAME",
     "build_kernel_table",
     "find_profiler_traces",
@@ -54,12 +56,57 @@ __all__ = [
     "last_worst",
     "load_trace_kernel_events",
     "parse_hlo_kernel_costs",
+    "primary_kernel_names",
     "publish_kernel_metrics",
+    "scan_kernel_series",
     "write_kernels_json",
 ]
 
 _SCHEMA_VERSION = 1
 KERNELS_JSON_NAME = "kernels.json"
+
+# The bench's per-kernel diag keys (``kernel_<name>_us`` /
+# ``kernel_<name>_mfu``) — matched against parsed dict keys by
+# bench.py's kernel_regression_guard and the rounds trajectory.
+BENCH_KERNEL_KEY_RE = re.compile(
+    r"^kernel_(?P<name>.+)_(?P<kind>us|mfu)$")
+
+# The same series in RAW artifact text: tolerates both plain JSON
+# (``"kernel_x_us": 1.2``) and the escaped form inside a tail-embedded
+# fragment (``\"kernel_x_us\": 1.2``) — committed artifacts come in
+# both, and BENCH_r05's fragment is truncated mid-line, so consumers
+# scan text instead of requiring a full parse.
+BENCH_KERNEL_SERIES_RE = re.compile(
+    r'\\?"kernel_(?P<name>[A-Za-z0-9_]+?)_(?P<kind>us|mfu)\\?"\s*:\s*'
+    r'(?P<value>-?[0-9][0-9.eE+\-]*)')
+
+
+def scan_kernel_series(text: str) -> Dict[str, Dict[str, float]]:
+    """``{kernel_name: {"us": ..., "mfu": ...}}`` scanned from raw
+    artifact text (the shared salvage used by obs/report.py's
+    bench-kernel section and the rounds trajectory)."""
+    kernels: Dict[str, Dict[str, float]] = {}
+    for match in BENCH_KERNEL_SERIES_RE.finditer(text):
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        entry = kernels.setdefault(match.group("name"), {})
+        entry[match.group("kind")] = value
+    return kernels
+
+
+def primary_kernel_names(names) -> set:
+    """The PRIMARY kernels among ``names``: a reading whose name
+    extends another's with a suffix (``conv0_gradw_s2d``,
+    ``lstm_grad_pallas_bf16``, ``..._b256``) is an experiment variant
+    of that measurement — it stays in tables but must not claim the
+    worst-kernel verdict over the production path."""
+    names = set(names)
+    return {
+        name for name in names
+        if not any(name != other and name.startswith(other + "_")
+                   for other in names)}
 
 # Kernels below this share of matched device time are excluded from the
 # "worst kernel" verdict: a 0.1%-of-time kernel at 0.01 MFU is noise,

@@ -40,7 +40,6 @@ import argparse
 import glob
 import json
 import os
-import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from scalable_agent_tpu.obs.aggregate import (
@@ -50,7 +49,11 @@ from scalable_agent_tpu.obs.aggregate import (
     parse_prometheus,
 )
 from scalable_agent_tpu.obs.exporters import _prom_name
-from scalable_agent_tpu.obs.kernels import KERNELS_JSON_NAME
+from scalable_agent_tpu.obs.kernels import (
+    KERNELS_JSON_NAME,
+    primary_kernel_names,
+    scan_kernel_series,
+)
 from scalable_agent_tpu.obs.ledger import (
     SEGMENT_LABELS,
     SEGMENTS,
@@ -110,11 +113,9 @@ RECOMMENDATIONS = {
         "rollouts / item 4 serving engine)"),
 }
 
-# Where the committed BENCH_r*.json artifacts live when the report runs
-# from a checkout (obs/ -> scalable_agent_tpu/ -> repo root).  Callers
+# Committed BENCH_r*.json artifacts resolve through the shared
+# obs/rounds.py discovery (default: the checkout's repo root).  Callers
 # outside a checkout pass --bench_dir or get no bench-kernel section.
-_DEFAULT_BENCH_DIR = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
 
 def _load_families(logdir: str) -> Tuple[Dict[str, dict], str]:
     """Parsed prometheus families for the logdir, folding multi-process
@@ -213,39 +214,29 @@ def _run_kernels(logdir: str) -> Optional[dict]:
     }
 
 
-# Tolerates both plain JSON (`"kernel_x_us": 1.2`) and the escaped
-# form inside a tail-embedded fragment (`\"kernel_x_us\": 1.2`).
-_BENCH_KERNEL_SERIES_RE = re.compile(
-    r'\\?"kernel_(?P<name>[A-Za-z0-9_]+?)_(?P<kind>us|mfu)\\?"\s*:\s*'
-    r'(?P<value>-?[0-9][0-9.eE+\-]*)')
-
-
 def _bench_kernels(bench_dir: Optional[str]) -> Optional[dict]:
     """Per-kernel readings from the newest committed bench artifact
     that has any ``kernel_<name>_us``/``kernel_<name>_mfu`` keys —
     the hand-measured rooflines (BENCH_r04/r05 found ``conv0_gradw``
     at 0.107 MFU) surfaced automatically.
 
-    Scans the RAW file text rather than parsing JSON: committed
-    artifacts come in three formats (the bench's one JSON line, the
-    driver's ``{"parsed": ...}`` wrapper, and a tail-embedded fragment
-    that may be TRUNCATED mid-line — BENCH_r05 is), and the kernel
-    series appear as ``"kernel_x_us": 1.2`` pairs in all of them."""
-    bench_dir = bench_dir or _DEFAULT_BENCH_DIR
-    files = sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
-    for path in reversed(files):  # newest artifact with kernel keys wins
+    Scans the RAW file text rather than parsing JSON (obs/kernels.py
+    ``scan_kernel_series``): committed artifacts come in three formats
+    (the bench's one JSON line, the driver's ``{"parsed": ...}``
+    wrapper, and a tail-embedded fragment that may be TRUNCATED
+    mid-line — BENCH_r05 is), and the kernel series appear as
+    ``"kernel_x_us": 1.2`` pairs in all of them.  Discovery is the
+    shared obs/rounds.py helper, so a stray non-round file can never
+    shadow the newest artifact."""
+    from scalable_agent_tpu.obs.rounds import discover_artifacts
+
+    for _, path in reversed(discover_artifacts(bench_dir)):
+        # Newest artifact with kernel keys wins.
         try:
             text = open(path).read()
         except OSError:
             continue
-        kernels: Dict[str, dict] = {}
-        for match in _BENCH_KERNEL_SERIES_RE.finditer(text):
-            try:
-                value = float(match.group("value"))
-            except ValueError:
-                continue
-            entry = kernels.setdefault(match.group("name"), {})
-            entry[match.group("kind")] = value
+        kernels = scan_kernel_series(text)
         if not kernels:
             continue
         rows = [{"name": name, "time_us": entry.get("us"),
@@ -253,15 +244,10 @@ def _bench_kernels(bench_dir: Optional[str]) -> Optional[dict]:
                 for name, entry in sorted(
                     kernels.items(),
                     key=lambda item: -(item[1].get("us") or 0.0))]
-        # The verdict considers only PRIMARY kernels: a reading whose
-        # name extends another's with a suffix (conv0_gradw_s2d,
-        # lstm_grad_pallas_bf16, ..._b256) is an experiment variant of
-        # that measurement — it stays in the table but must not claim
-        # the roofline-target verdict over the production path.
-        primaries = {
-            name for name in kernels
-            if not any(name != other and name.startswith(other + "_")
-                       for other in kernels)}
+        # The verdict considers only PRIMARY kernels (obs/kernels.py):
+        # variant suffixes stay in the table but must not claim the
+        # roofline-target verdict over the production path.
+        primaries = primary_kernel_names(kernels)
         candidates = [r for r in rows if r["name"] in primaries]
         with_mfu = [r for r in candidates if r["mfu"] is not None]
         worst = min(with_mfu, key=lambda r: r["mfu"], default=None)

@@ -1,0 +1,1257 @@
+"""Bench-round orchestrator, artifact validator, and longitudinal
+performance trajectory + acceptance scoreboard.  jax-free — run it on a
+laptop against the checkout, like obs/report.py and obs/aggregate.py.
+
+::
+
+    python -m scalable_agent_tpu.obs.rounds run [--suites a,b] [--round N]
+    python -m scalable_agent_tpu.obs.rounds report [--json]
+    python -m scalable_agent_tpu.obs.rounds validate [--json] [--write_salvage]
+
+**run** replaces the monolithic ``python bench.py`` round with isolated
+stages: every bench suite (``bench.py --list`` is the registry) executes
+in its OWN subprocess under its own timeout, so one crashing or hanging
+suite lands as ``{"status": "failed"/"timeout", ...}`` in the round
+artifact instead of losing every other suite's numbers (BENCH_r05.json
+is literally truncated mid-key — that failure mode is what this
+orchestrator retires).  Results accumulate through a context file
+(later suites see ``sec_per_update`` etc. from earlier ones), the
+regression guards run as the final stage over the full merged round,
+and the schema-versioned artifact — per-stage status/wall-time, an
+environment fingerprint, the merged flat metrics dict every existing
+consumer understands, and the guard summary — is written ATOMICALLY as
+``BENCH_r<NN>.json``.  ``--suites a,b`` re-runs just those suites and
+merges onto the newest round artifact, so a failed suite is re-run
+alone instead of re-paying the whole round.
+
+**report** is the cross-round layer the committed artifacts never had:
+it parses ALL ``BENCH_r*.json`` + ``MULTICHIP_r*.json`` (tolerating
+the three historical formats — raw bench line, driver ``{"parsed":
+...}`` wrapper, truncated tail fragment, plus this module's schema-v1
+rounds), computes per-metric round-over-round series and deltas, the
+per-kernel trajectory (``conv0_gradw`` across rounds), the
+``learning_curve`` return-vs-updates series, and the **acceptance
+scoreboard**: ROADMAP's r06 targets encoded as machine-readable
+thresholds, each scored met/unmet/unmeasured per round — the next TPU
+round grades itself the moment its artifact lands.
+
+**validate** checks every committed artifact for truncation and schema
+violations; a truncated artifact is an error unless a machine-written
+``<name>.salvage.json`` sidecar acknowledges the loss
+(``--write_salvage`` generates it from the regex salvage — never by
+hand).  tests/test_rounds.py runs validate over the repo's own
+artifacts in tier-1, so a future truncated-tail commit fails fast.
+
+This module also owns the ONE artifact-discovery/parse helper
+(``discover_artifacts`` / ``parse_bench_artifact`` /
+``newest_artifact``) that bench.py's regression guards and
+obs/report.py's bench-kernel section previously each re-implemented.
+
+See docs/benchmarking.md for the operator guide and the r06 checklist.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from scalable_agent_tpu.obs.kernels import (
+    BENCH_KERNEL_KEY_RE,
+    primary_kernel_names,
+)
+
+__all__ = [
+    "R06_TARGETS",
+    "SCHEMA_VERSION",
+    "AcceptanceTarget",
+    "ParsedArtifact",
+    "build_trajectory",
+    "default_bench_dir",
+    "discover_artifacts",
+    "environment_fingerprint",
+    "load_multichip",
+    "main",
+    "newest_artifact",
+    "parse_bench_artifact",
+    "render_trajectory",
+    "render_validation",
+    "run_round",
+    "salvage_metrics",
+    "score_round",
+    "sidecar_path",
+    "validate_artifacts",
+    "write_salvage_sidecar",
+]
+
+SCHEMA_VERSION = 1
+
+# Artifact families live at the repo root (obs/ -> scalable_agent_tpu/
+# -> root), the same resolution obs/report.py uses for its bench-kernel
+# section.
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# The r-NUMBER pattern, strictly: BENCH_r05.salvage.json and any future
+# BENCH_summary.json must never be mistaken for a round artifact (a
+# stray file sorting last would silently disarm every regression guard
+# that compares against "the newest artifact").
+_ROUND_NAME_RE = re.compile(r"^(?P<prefix>[A-Z]+)_r(?P<round>\d+)\.json$")
+
+SALVAGE_SUFFIX = ".salvage.json"
+
+# Keys that belong to the driver's wrapper (or to this module's own
+# schema), never to the bench metrics dict — excluded when salvaging
+# from raw file text.
+_WRAPPER_KEYS = frozenset(("n", "cmd", "rc", "tail", "parsed"))
+
+# ``"key": value`` pairs in a (possibly truncated) bench JSON line:
+# numbers, booleans/null, and strings.  Keys are bench-style
+# identifiers only, so quoted prose and traceback paths never match.
+_SCALAR_PAIR_RE = re.compile(
+    r'"(?P<key>[A-Za-z_][A-Za-z0-9_]*)"\s*:\s*(?:'
+    r'(?P<num>-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)'
+    r'|(?P<kw>true|false|null)'
+    r'|"(?P<str>(?:[^"\\]|\\.)*)")')
+
+# Two-level numeric arrays worth recovering whole (the learning curve
+# and the replay-ratio curve are [[x, y, ...], ...] series).
+_CURVE_KEYS = ("learning_curve", "replay_ratio_curve")
+_CURVE_RE = {
+    key: re.compile(
+        r'"%s"\s*:\s*(?P<arr>\[(?:[^\[\]]|\[[^\[\]]*\])*\])' % key)
+    for key in _CURVE_KEYS
+}
+
+_MESH_RE = re.compile(r"over mesh \(([^)]*)\)")
+_TOTAL_LOSS_RE = re.compile(r"total_loss=(-?[0-9.]+(?:[eE][+-]?[0-9]+)?)")
+
+
+def default_bench_dir() -> str:
+    """Where the committed BENCH_r*/MULTICHIP_r* artifacts live."""
+    return _REPO_ROOT
+
+
+def discover_artifacts(bench_dir: Optional[str] = None,
+                       prefix: str = "BENCH") -> List[Tuple[int, str]]:
+    """``[(round_number, path)]`` for ``<prefix>_r<NN>.json`` under
+    ``bench_dir``, sorted by round NUMBER (not lexically — r9 < r10).
+    The shared discovery every regression guard and report section
+    uses; salvage sidecars and stray summary files never match."""
+    bench_dir = os.path.abspath(bench_dir or default_bench_dir())
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, prefix + "_r*.json")):
+        match = _ROUND_NAME_RE.match(os.path.basename(path))
+        if match and match.group("prefix") == prefix:
+            out.append((int(match.group("round")), path))
+    return sorted(out)
+
+
+def sidecar_path(artifact_path: str) -> str:
+    """``BENCH_r05.json`` -> ``BENCH_r05.salvage.json``."""
+    base, _ = os.path.splitext(artifact_path)
+    return base + SALVAGE_SUFFIX
+
+
+def salvage_metrics(text: str) -> Dict[str, object]:
+    """Best-effort flat metrics recovered from a (possibly truncated)
+    bench JSON fragment by regex — the same raw-text approach
+    obs/report.py's bench-kernel section uses, generalized to every
+    scalar pair plus the curve arrays.  Nested-object scalars (e.g.
+    ``e2e_config.groups``) flatten in; on key collision the LAST
+    occurrence wins, matching JSON object semantics."""
+    metrics: Dict[str, object] = {}
+    for key, pattern in _CURVE_RE.items():
+        match = pattern.search(text)
+        if match:
+            try:
+                metrics[key] = json.loads(match.group("arr"))
+            except ValueError:
+                pass
+    for match in _SCALAR_PAIR_RE.finditer(text):
+        key = match.group("key")
+        if key in _WRAPPER_KEYS:
+            continue
+        if match.group("num") is not None:
+            token = match.group("num")
+            try:
+                value = int(token) if re.fullmatch(r"-?\d+", token) \
+                    else float(token)
+            except ValueError:
+                continue
+        elif match.group("kw") is not None:
+            value = {"true": True, "false": False,
+                     "null": None}[match.group("kw")]
+        else:
+            value = match.group("str")
+        metrics[key] = value
+    return metrics
+
+
+class ParsedArtifact(NamedTuple):
+    """One committed artifact, best-effort parsed.
+
+    ``kind`` is the schema the file actually matched:
+
+    - ``bench_line``: the bench's own one-JSON-line dict
+    - ``wrapper_parsed``: driver wrapper with a parsed bench dict
+    - ``wrapper_tail``: driver wrapper, bench line recovered whole
+      from the captured tail
+    - ``wrapper_salvaged``: driver wrapper whose embedded bench line is
+      TRUNCATED — metrics regex-salvaged from the surviving fragment
+    - ``wrapper_failed``: driver wrapper of a round that errored before
+      emitting any bench line (rc != 0)
+    - ``round_v1``: this module's schema-versioned round artifact
+    - ``invalid``: unreadable / not a recognized schema
+    """
+
+    path: str
+    name: str
+    round: Optional[int]
+    kind: str
+    metrics: Optional[dict]
+    salvaged: bool
+    sidecar: Optional[dict]
+    error: Optional[str]
+    raw: Optional[dict]
+
+
+def _load_sidecar(artifact_path: str) -> Optional[dict]:
+    path = sidecar_path(artifact_path)
+    if not os.path.exists(path):
+        return None
+    try:
+        sidecar = json.load(open(path))
+    except (OSError, ValueError):
+        return {"error": f"unreadable sidecar {os.path.basename(path)}"}
+    return sidecar if isinstance(sidecar, dict) else None
+
+
+def parse_bench_artifact(path: str) -> ParsedArtifact:
+    """Parse one BENCH-family artifact, handling every schema committed
+    across rounds r01-r05 plus this module's own v1 rounds.  Never
+    raises: unparseable files come back ``kind="invalid"`` with any
+    regex-salvageable metrics attached."""
+    name = os.path.basename(path)
+    match = _ROUND_NAME_RE.match(name)
+    round_no = int(match.group("round")) if match else None
+    sidecar = _load_sidecar(path)
+
+    def result(kind, metrics=None, salvaged=False, error=None, raw=None):
+        return ParsedArtifact(path, name, round_no, kind, metrics,
+                              salvaged, sidecar, error, raw)
+
+    try:
+        raw_text = open(path, errors="replace").read()
+    except OSError as exc:
+        return result("invalid", error=str(exc))
+    try:
+        raw = json.loads(raw_text)
+    except ValueError:
+        # The file itself is torn.  Salvage from the raw text (tail
+        # fragments there carry escaped quotes — normalize first).
+        metrics = salvage_metrics(raw_text.replace('\\"', '"'))
+        return result("invalid", metrics=metrics or None,
+                      salvaged=bool(metrics), error="unreadable JSON")
+    if not isinstance(raw, dict):
+        return result("invalid", error="not a JSON object")
+
+    if isinstance(raw.get("schema_version"), int) and "stages" in raw:
+        merged = raw.get("merged")
+        return result("round_v1",
+                      metrics=merged if isinstance(merged, dict) else {},
+                      raw=raw)
+    if "metric" in raw:
+        return result("bench_line", metrics=raw, raw=raw)
+    parsed = raw.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return result("wrapper_parsed", metrics=parsed, raw=raw)
+    if "tail" in raw:
+        tail = str(raw.get("tail") or "")
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return result("wrapper_tail", metrics=cand, raw=raw)
+        metrics = salvage_metrics(tail)
+        numeric = [k for k, v in metrics.items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)]
+        if raw.get("rc") == 0 and len(numeric) >= 3:
+            # The round SUCCEEDED (rc 0) but its bench line survives
+            # only as a truncated fragment: salvage it.
+            return result("wrapper_salvaged", metrics=metrics,
+                          salvaged=True,
+                          error="embedded bench line truncated",
+                          raw=raw)
+        return result("wrapper_failed",
+                      error=f"round failed (rc={raw.get('rc')}), "
+                            f"no bench line emitted",
+                      raw=raw)
+    return result("invalid", error="unrecognized artifact schema",
+                  raw=raw)
+
+
+def newest_artifact(bench_dir: Optional[str] = None,
+                    exclude_names: Sequence[str] = ()
+                    ) -> Optional[ParsedArtifact]:
+    """The newest BENCH_r*.json, parsed — what every regression guard
+    compares against.  ``exclude_names`` skips artifacts by basename:
+    a subset re-run's guards must compare against the PREVIOUS round,
+    not the round artifact they are being merged onto."""
+    skip = set(exclude_names)
+    found = [(number, path) for number, path in
+             discover_artifacts(bench_dir)
+             if os.path.basename(path) not in skip]
+    if not found:
+        return None
+    return parse_bench_artifact(found[-1][1])
+
+
+# -- validate ---------------------------------------------------------------
+
+# Keys every complete bench line carries (the bench's exactly-one-JSON-
+# line contract).
+_BENCH_REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline")
+_MULTICHIP_REQUIRED_KEYS = ("n_devices", "rc", "ok")
+_ROUND_STAGE_STATUSES = frozenset(("ok", "failed", "timeout", "skipped"))
+
+
+def _validate_round_v1(raw: dict, errors: List[str], name: str) -> None:
+    if not isinstance(raw.get("round"), int):
+        errors.append(f"{name}: schema v1 artifact missing integer "
+                      f"'round'")
+    if not isinstance(raw.get("fingerprint"), dict):
+        errors.append(f"{name}: schema v1 artifact missing "
+                      f"'fingerprint'")
+    stages = raw.get("stages")
+    if not isinstance(stages, dict) or not stages:
+        errors.append(f"{name}: schema v1 artifact has no stages")
+        return
+    for stage_name, record in stages.items():
+        if not isinstance(record, dict):
+            errors.append(f"{name}: stage {stage_name} is not an object")
+            continue
+        if record.get("status") not in _ROUND_STAGE_STATUSES:
+            errors.append(
+                f"{name}: stage {stage_name} has invalid status "
+                f"{record.get('status')!r}")
+        if not isinstance(record.get("wall_s"), (int, float)):
+            errors.append(f"{name}: stage {stage_name} missing wall_s")
+    if not isinstance(raw.get("merged"), dict):
+        errors.append(f"{name}: schema v1 artifact missing 'merged'")
+
+
+def validate_artifacts(bench_dir: Optional[str] = None,
+                       write_salvage: bool = False) -> dict:
+    """Truncation + schema check over every committed artifact.
+
+    Returns ``{"ok", "bench_dir", "artifacts": [...], "errors": [...]}``.
+    A truncated bench line is an ERROR unless a ``.salvage.json``
+    sidecar acknowledges it (and matches a fresh salvage — a stale
+    sidecar is also an error); ``write_salvage=True`` writes/refreshes
+    the sidecar instead of erroring."""
+    bench_dir = os.path.abspath(bench_dir or default_bench_dir())
+    artifacts: List[dict] = []
+    errors: List[str] = []
+
+    for _, path in discover_artifacts(bench_dir, prefix="BENCH"):
+        art = parse_bench_artifact(path)
+        entry = {"name": art.name, "round": art.round, "kind": art.kind,
+                 "status": "ok", "notes": []}
+        if art.kind == "invalid":
+            entry["status"] = "invalid"
+            errors.append(f"{art.name}: {art.error}")
+        elif art.kind == "wrapper_failed":
+            # An honestly-failed round (the error is on record inside
+            # the artifact) — a gap in the trajectory, not a violation.
+            entry["status"] = "failed_round"
+            entry["notes"].append(art.error)
+        elif art.kind == "wrapper_salvaged":
+            entry["salvaged_keys"] = len(art.metrics or {})
+            sidecar = art.sidecar
+            if write_salvage:
+                write_salvage_sidecar(path, art.metrics or {})
+                entry["status"] = "salvaged"
+                entry["notes"].append(
+                    f"sidecar written: "
+                    f"{os.path.basename(sidecar_path(path))}")
+            elif sidecar is None:
+                entry["status"] = "truncated"
+                errors.append(
+                    f"{art.name}: embedded bench line is TRUNCATED and "
+                    f"no {os.path.basename(sidecar_path(path))} sidecar "
+                    f"acknowledges the loss — run `rounds validate "
+                    f"--write_salvage` and commit the sidecar")
+            elif sidecar.get("error"):
+                entry["status"] = "truncated"
+                errors.append(f"{art.name}: {sidecar['error']}")
+            elif sidecar.get("metrics") != art.metrics:
+                entry["status"] = "truncated"
+                errors.append(
+                    f"{art.name}: salvage sidecar is STALE (its metrics "
+                    f"no longer match a fresh salvage) — regenerate "
+                    f"with `rounds validate --write_salvage`")
+            else:
+                entry["status"] = "salvaged"
+                entry["notes"].append("sidecar verified")
+        elif art.kind == "round_v1":
+            _validate_round_v1(art.raw, errors, art.name)
+            failed = [s for s, rec in (art.raw.get("stages") or {}).items()
+                      if isinstance(rec, dict)
+                      and rec.get("status") in ("failed", "timeout")]
+            if failed:
+                entry["notes"].append(
+                    "stages failed: " + ", ".join(sorted(failed)))
+        else:  # bench_line / wrapper_parsed / wrapper_tail
+            missing = [key for key in _BENCH_REQUIRED_KEYS
+                       if key not in (art.metrics or {})]
+            if missing:
+                entry["status"] = "schema_violation"
+                errors.append(
+                    f"{art.name}: bench line missing required keys "
+                    f"{missing}")
+        artifacts.append(entry)
+
+    for _, path in discover_artifacts(bench_dir, prefix="MULTICHIP"):
+        name = os.path.basename(path)
+        entry = {"name": name, "kind": "multichip", "status": "ok",
+                 "notes": []}
+        try:
+            raw = json.load(open(path))
+        except (OSError, ValueError):
+            entry["status"] = "invalid"
+            errors.append(f"{name}: unreadable JSON")
+            artifacts.append(entry)
+            continue
+        missing = [key for key in _MULTICHIP_REQUIRED_KEYS
+                   if key not in raw]
+        if missing:
+            entry["status"] = "schema_violation"
+            errors.append(f"{name}: missing required keys {missing}")
+        elif not raw.get("ok") and not raw.get("skipped"):
+            entry["notes"].append("round reported ok=false")
+        artifacts.append(entry)
+
+    return {"ok": not errors, "bench_dir": bench_dir,
+            "artifacts": artifacts, "errors": errors}
+
+
+def write_salvage_sidecar(artifact_path: str, metrics: dict,
+                          note: Optional[str] = None) -> str:
+    """Machine-write the salvage sidecar for a truncated artifact.
+    The committed JSON is never edited; the sidecar records what the
+    regex salvage recovers and names what is lost."""
+    name = os.path.basename(artifact_path)
+    path = sidecar_path(artifact_path)
+    sidecar = {
+        "schema_version": SCHEMA_VERSION,
+        "salvaged_from": name,
+        "generated_by": ("python -m scalable_agent_tpu.obs.rounds "
+                         "validate --write_salvage"),
+        "note": note or (
+            f"{name}'s embedded bench JSON line is truncated at its "
+            f"HEAD (the driver kept only the output tail): every key "
+            f"before the first surviving pair — the headline learner "
+            f"fps/mfu/sec_per_update, platform/device identification, "
+            f"and the link diagnostics — is lost.  The metrics below "
+            f"were recovered from the surviving fragment by "
+            f"`rounds validate --write_salvage` (regex salvage, zero "
+            f"hand-editing) and are what the trajectory report reads "
+            f"for this round."),
+        "metrics": metrics,
+    }
+    _atomic_write_json(path, sidecar)
+    return path
+
+
+def render_validation(result: dict) -> str:
+    lines = [f"artifact validation — {result['bench_dir']}"]
+    for entry in result["artifacts"]:
+        notes = ("  (" + "; ".join(entry["notes"]) + ")"
+                 if entry.get("notes") else "")
+        lines.append(f"  {entry['name']:<28} {entry['status']}{notes}")
+    for error in result["errors"]:
+        lines.append(f"ERROR: {error}")
+    lines.append("validation: " + ("OK" if result["ok"] else "FAILED"))
+    return "\n".join(lines) + "\n"
+
+
+# -- the trajectory + scoreboard --------------------------------------------
+
+# (metric key, human label, unit hint) — the per-round series the
+# report tracks.  Keys are the bench's own diag names, so a metric
+# appears the round its stage first shipped and the series tolerates
+# schema drift by construction.
+TRAJECTORY_METRICS: Tuple[Tuple[str, str, str], ...] = (
+    ("value", "learner fps (B=32)", "fps"),
+    ("vs_baseline", "learner vs 30k baseline", "x"),
+    ("mfu", "learner MFU (B=32)", "frac"),
+    ("sec_per_update", "sec/update (B=32)", "s"),
+    ("learner_b256_env_frames_per_sec", "learner fps (B=256)", "fps"),
+    ("learner_b256_mfu", "learner MFU (B=256)", "frac"),
+    ("e2e_env_frames_per_sec", "host e2e fps", "fps"),
+    ("e2e_vs_baseline", "host e2e vs baseline", "x"),
+    ("ingraph_env_frames_per_sec", "in-graph e2e fps", "fps"),
+    ("ingraph_vs_baseline", "in-graph e2e vs baseline", "x"),
+    ("link_rtt_ms", "link RTT", "ms"),
+    ("link_h2d_flat_mb_s", "link H2D bandwidth", "MB/s"),
+    ("learning_final_return", "learning final return", "return"),
+    ("service_vs_grouped", "service vs grouped e2e", "x"),
+    ("replay_sampled_vs_fresh_fps", "replay sampled vs fresh", "x"),
+)
+
+
+class AcceptanceTarget(NamedTuple):
+    """One machine-readable acceptance criterion (ROADMAP r06)."""
+
+    name: str
+    key: str          # the bench/report metric key it reads
+    op: str           # ">=" or "=="
+    threshold: object
+    description: str
+    roadmap: str
+
+
+# ROADMAP's r06 criteria, encoded.  ``dominant_stage_verdict`` is the
+# obs.report verdict a round records via
+# `python -m scalable_agent_tpu.obs.report <logdir> --json` on the
+# round's driver logdir (docs/benchmarking.md shows the attach step);
+# rounds that never ran a ledger-instrumented driver leave it
+# unmeasured.
+R06_TARGETS: Tuple[AcceptanceTarget, ...] = (
+    AcceptanceTarget(
+        "service_vs_grouped", "service_vs_grouped", ">=", 2.0,
+        "continuous-batching actor service e2e fps >= 2x the grouped "
+        "lockstep pool at equal env count", "item 1(a)"),
+    AcceptanceTarget(
+        "device_resident_e2e", "ingraph_vs_baseline", ">=", 10.0,
+        "device-resident (in-graph) e2e >= 10x the 30k fps baseline "
+        "on one chip", "item 1(b)"),
+    AcceptanceTarget(
+        "dominant_stage_device_bound", "dominant_stage_verdict", "==",
+        "device_bound",
+        "obs.report dominant-stage verdict flips learner_starved -> "
+        "device_bound", "item 1(c)"),
+    AcceptanceTarget(
+        "replay_sampled_fps", "replay_sampled_vs_fresh_fps", ">=", 0.95,
+        "sampled-update fps >= 0.95x fresh at the learner batch",
+        "item 2"),
+    AcceptanceTarget(
+        "learner_mfu", "mfu", ">=", 0.40,
+        "learner update MFU >= 0.40 at B=32", "item 3"),
+)
+
+
+def score_round(metrics: Optional[dict],
+                targets: Sequence[AcceptanceTarget] = R06_TARGETS
+                ) -> Dict[str, dict]:
+    """Score one round's merged metrics against the acceptance
+    targets: ``{target_name: {"status": met|unmet|unmeasured,
+    "value", "threshold"}}``."""
+    out = {}
+    for target in targets:
+        value = (metrics or {}).get(target.key)
+        if value is None or isinstance(value, bool):
+            status = "unmeasured"
+        elif target.op == ">=":
+            if isinstance(value, (int, float)):
+                status = "met" if value >= target.threshold else "unmet"
+            else:
+                status = "unmeasured"
+        else:  # "=="
+            status = "met" if value == target.threshold else "unmet"
+        out[target.name] = {
+            "status": status,
+            "value": value if status != "unmeasured" else None,
+            "threshold": target.threshold,
+        }
+    return out
+
+
+def load_multichip(bench_dir: Optional[str] = None) -> List[dict]:
+    """The MULTICHIP_r*.json series: device count, pass/fail, the mesh
+    shape and final loss recovered from the captured tail."""
+    out = []
+    for round_no, path in discover_artifacts(bench_dir,
+                                             prefix="MULTICHIP"):
+        name = os.path.basename(path)
+        try:
+            raw = json.load(open(path))
+        except (OSError, ValueError):
+            out.append({"round": round_no, "name": name, "valid": False})
+            continue
+        tail = str(raw.get("tail") or "")
+        mesh = _MESH_RE.search(tail)
+        loss = _TOTAL_LOSS_RE.search(tail)
+        out.append({
+            "round": round_no, "name": name, "valid": True,
+            "n_devices": raw.get("n_devices"), "ok": raw.get("ok"),
+            "rc": raw.get("rc"), "skipped": raw.get("skipped"),
+            "mesh": mesh.group(1) if mesh else None,
+            "total_loss": float(loss.group(1)) if loss else None,
+        })
+    return out
+
+
+def build_trajectory(bench_dir: Optional[str] = None) -> dict:
+    """The longitudinal view over every committed round: per-metric
+    series + deltas, kernel series, learning curves, multichip series,
+    and the acceptance scoreboard — the ``report --json`` payload."""
+    bench_dir = os.path.abspath(bench_dir or default_bench_dir())
+    parsed = [parse_bench_artifact(path)
+              for _, path in discover_artifacts(bench_dir)]
+
+    rounds_out: List[dict] = []
+    series: Dict[str, Dict[int, float]] = {}
+    kernels: Dict[str, Dict[int, dict]] = {}
+    worst_kernel: Dict[int, dict] = {}
+    learning_curves: Dict[int, list] = {}
+    scoreboard: Dict[int, Dict[str, dict]] = {}
+
+    for art in parsed:
+        metrics = art.metrics or {}
+        round_errors = metrics.get("errors")
+        rounds_out.append({
+            "round": art.round, "name": art.name, "kind": art.kind,
+            "salvaged": art.salvaged,
+            "has_sidecar": art.sidecar is not None,
+            "platform": metrics.get("platform"),
+            "device_kind": metrics.get("device_kind"),
+            "has_metrics": bool(metrics),
+            "error": art.error,
+            "errors_recorded": (len(round_errors)
+                                if isinstance(round_errors, list)
+                                else 0),
+        })
+        if art.round is None:
+            continue
+        for key, _, _ in TRAJECTORY_METRICS:
+            value = metrics.get(key)
+            if (isinstance(value, (int, float))
+                    and not isinstance(value, bool)):
+                series.setdefault(key, {})[art.round] = value
+        round_kernels: Dict[str, dict] = {}
+        for key, value in metrics.items():
+            match = BENCH_KERNEL_KEY_RE.match(key)
+            if (not match or not isinstance(value, (int, float))
+                    or isinstance(value, bool)):
+                continue
+            entry = round_kernels.setdefault(match.group("name"), {})
+            entry[match.group("kind")] = value
+        for kernel_name, entry in round_kernels.items():
+            kernels.setdefault(kernel_name, {})[art.round] = entry
+        if round_kernels:
+            # The worst-kernel verdict considers only primary kernels
+            # (obs/kernels.py: variant suffixes like _s2d/_b256 are
+            # experiments riding a primary measurement).
+            primaries = primary_kernel_names(round_kernels)
+            with_mfu = [(n, e) for n, e in round_kernels.items()
+                        if n in primaries and e.get("mfu") is not None]
+            if with_mfu:
+                name, entry = min(with_mfu,
+                                  key=lambda item: item[1]["mfu"])
+                worst_kernel[art.round] = {
+                    "name": name, "mfu": entry["mfu"],
+                    "us": entry.get("us")}
+        curve = metrics.get("learning_curve")
+        if isinstance(curve, list) and curve:
+            learning_curves[art.round] = curve
+        if metrics:
+            scoreboard[art.round] = score_round(metrics)
+
+    deltas: Dict[str, Dict[int, float]] = {}
+    for key, points in series.items():
+        ordered = sorted(points)
+        for prev_round, cur_round in zip(ordered, ordered[1:]):
+            prev_value = points[prev_round]
+            if prev_value:
+                deltas.setdefault(key, {})[cur_round] = round(
+                    points[cur_round] / prev_value - 1.0, 4)
+
+    headline = {}
+    for key in ("value", "e2e_env_frames_per_sec",
+                "ingraph_env_frames_per_sec", "mfu"):
+        points = series.get(key)
+        if not points:
+            continue
+        best_round = max(points, key=points.get)
+        latest_round = max(points)
+        headline[key] = {
+            "latest": {"round": latest_round,
+                       "value": points[latest_round]},
+            "best": {"round": best_round, "value": points[best_round]},
+        }
+
+    measured_rounds = sorted(scoreboard)
+    latest = measured_rounds[-1] if measured_rounds else None
+    return {
+        "bench_dir": bench_dir,
+        "rounds": rounds_out,
+        "series": series,
+        "deltas": deltas,
+        "headline": headline,
+        "kernels": kernels,
+        "worst_kernel": worst_kernel,
+        "learning_curves": learning_curves,
+        "multichip": load_multichip(bench_dir),
+        "targets": [target._asdict() for target in R06_TARGETS],
+        "scoreboard": scoreboard,
+        "latest_round": latest,
+        "latest_scoreboard": scoreboard.get(latest),
+    }
+
+
+def _fmt_value(value, unit: str = "") -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return str(value)
+    if not isinstance(value, (int, float)):
+        return str(value)
+    magnitude = abs(value)
+    if magnitude >= 1e6:
+        return f"{value / 1e6:.2f}M"
+    if magnitude >= 1e4:
+        return f"{value / 1e3:.1f}k"
+    if magnitude >= 100:
+        return f"{value:.0f}"
+    return f"{value:.3g}"
+
+
+def render_trajectory(trajectory: dict) -> str:
+    """The human-readable longitudinal report."""
+    rounds = [r["round"] for r in trajectory["rounds"]
+              if r["round"] is not None]
+    rounds = sorted(set(rounds))
+    lines = [f"Bench-round trajectory — {trajectory['bench_dir']}", ""]
+
+    for entry in trajectory["rounds"]:
+        flags = []
+        if entry["salvaged"]:
+            flags.append("SALVAGED" + (" +sidecar"
+                                       if entry["has_sidecar"] else ""))
+        if entry["error"] and not entry["salvaged"]:
+            flags.append(entry["error"])
+        if entry["errors_recorded"]:
+            flags.append(f"{entry['errors_recorded']} errors recorded")
+        platform = entry["platform"] or "?"
+        lines.append(
+            f"  r{entry['round']:02d}  {entry['kind']:<16} "
+            f"{platform:<4} {'; '.join(flags)}".rstrip())
+    lines.append("")
+
+    width = 9
+    header = f"{'metric':<28}" + "".join(
+        f"{'r%02d' % r:>{width}}" for r in rounds)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for key, label, unit in TRAJECTORY_METRICS:
+        points = trajectory["series"].get(key)
+        if not points:
+            continue
+        row = f"{label[:27]:<28}" + "".join(
+            f"{_fmt_value(points.get(r)):>{width}}" for r in rounds)
+        lines.append(row)
+
+    if trajectory["kernels"]:
+        lines.append("")
+        lines.append("per-kernel series (us / mfu):")
+        for kernel_name in sorted(trajectory["kernels"]):
+            points = trajectory["kernels"][kernel_name]
+            row = f"  {kernel_name[:26]:<26}" + "".join(
+                "{:>{w}}".format(
+                    ("-" if r not in points else
+                     _fmt_value(points[r].get("us"))
+                     + ("/" + format(points[r]["mfu"], ".3f")
+                        if points[r].get("mfu") is not None else "")),
+                    w=width + 4)
+                for r in rounds)
+            lines.append(row)
+        for round_no in sorted(trajectory["worst_kernel"]):
+            worst = trajectory["worst_kernel"][round_no]
+            lines.append(
+                f"  worst kernel r{round_no:02d}: {worst['name']} "
+                f"(mfu {worst['mfu']:.3f}) — the roofline target "
+                f"(ROADMAP item 3)")
+
+    if trajectory["learning_curves"]:
+        lines.append("")
+        lines.append("learning curves (return vs updates, fake_bandit):")
+        for round_no in sorted(trajectory["learning_curves"]):
+            curve = trajectory["learning_curves"][round_no]
+            path = "  ".join(
+                f"{int(point[0])}:{point[1]}" for point in curve
+                if isinstance(point, list) and len(point) >= 2)
+            lines.append(f"  r{round_no:02d}  {path}")
+
+    multichip = [m for m in trajectory["multichip"] if m.get("valid")]
+    if multichip:
+        lines.append("")
+        lines.append("multichip dryrun series:")
+        for entry in multichip:
+            lines.append(
+                f"  r{entry['round']:02d}  {entry['n_devices']} devices  "
+                f"{'OK' if entry['ok'] else 'FAIL'}  "
+                f"mesh({entry['mesh'] or 'data-only'})  "
+                f"loss {_fmt_value(entry['total_loss'])}")
+
+    lines.append("")
+    lines.append("acceptance scoreboard (ROADMAP r06 targets):")
+    score_rounds = sorted(trajectory["scoreboard"])
+    header = f"  {'target':<28}" + "".join(
+        f"{'r%02d' % r:>15}" for r in score_rounds)
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    for target in R06_TARGETS:
+        row = f"  {target.name[:27]:<28}"
+        for round_no in score_rounds:
+            cell = trajectory["scoreboard"][round_no][target.name]
+            mark = {"met": "MET", "unmet": "unmet",
+                    "unmeasured": "·"}[cell["status"]]
+            if cell["status"] == "unmet" and cell["value"] is not None:
+                mark = f"unmet({_fmt_value(cell['value'])})"
+            row += f"{mark:>15}"
+        lines.append(row)
+    latest = trajectory["latest_round"]
+    if latest is not None:
+        counts = {"met": 0, "unmet": 0, "unmeasured": 0}
+        for cell in trajectory["latest_scoreboard"].values():
+            counts[cell["status"]] += 1
+        lines.append(
+            f"  latest measured round r{latest:02d}: {counts['met']} "
+            f"met, {counts['unmet']} unmet, {counts['unmeasured']} "
+            f"unmeasured — the r06 round must flip every column "
+            f"(docs/benchmarking.md)")
+    return "\n".join(lines) + "\n"
+
+
+# -- the round runner -------------------------------------------------------
+
+GUARDS_STAGE = "guards"
+GUARDS_TIMEOUT_S = 300.0
+REGISTRY_TIMEOUT_S = 120.0
+# Keys that are bookkeeping, not metrics — stripped from contexts and
+# per-stage data.
+_BOOKKEEPING_KEYS = ("errors", "warnings", "stage", "guard_summary")
+# Fingerprint keys each suite re-reports from its own backend init;
+# lifted into the round fingerprint from the merged context.
+_FINGERPRINT_FROM_RUN = ("platform", "device_kind", "n_devices",
+                         "jax_version")
+_ENV_FLAG_PREFIXES = ("JAX_", "XLA_", "BENCH_", "SCALABLE_AGENT_",
+                      "LIBTPU_", "TPU_")
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as handle:
+            json.dump(obj, handle, indent=1, sort_keys=False)
+            handle.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def environment_fingerprint(bench_dir: Optional[str] = None) -> dict:
+    """git sha + toolchain versions + accelerator-relevant env flags.
+    jax/jaxlib versions come from package metadata (no jax import)."""
+    bench_dir = os.path.abspath(bench_dir or default_bench_dir())
+    fingerprint = {
+        "created_unix": round(time.time(), 1),
+        "python": sys.version.split()[0],
+        "node": getattr(os.uname(), "nodename", None)
+        if hasattr(os, "uname") else None,
+    }
+    try:
+        sha = subprocess.run(
+            ["git", "-C", bench_dir, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        fingerprint["git_sha"] = (sha.stdout.strip()
+                                  if sha.returncode == 0 else None)
+        dirty = subprocess.run(
+            ["git", "-C", bench_dir, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10)
+        fingerprint["git_dirty"] = (bool(dirty.stdout.strip())
+                                    if dirty.returncode == 0 else None)
+    except (OSError, subprocess.SubprocessError):
+        fingerprint["git_sha"] = None
+        fingerprint["git_dirty"] = None
+    try:
+        from importlib import metadata as importlib_metadata
+        for package in ("jax", "jaxlib"):
+            try:
+                fingerprint[package] = importlib_metadata.version(package)
+            except importlib_metadata.PackageNotFoundError:
+                fingerprint[package] = None
+    except ImportError:  # pragma: no cover
+        pass
+    fingerprint["flags"] = {
+        key: os.environ[key] for key in sorted(os.environ)
+        if key.startswith(_ENV_FLAG_PREFIXES)}
+    return fingerprint
+
+
+def load_registry(bench_cmd: Sequence[str],
+                  timeout_s: float = REGISTRY_TIMEOUT_S) -> dict:
+    """The bench's suite/guard registry via ``bench.py --list --json``
+    (stdlib-only on the bench side — no jax import, so this is fast)."""
+    proc = subprocess.run(
+        list(bench_cmd) + ["--list", "--json"],
+        capture_output=True, text=True, timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench --list failed (rc={proc.returncode}): "
+            f"{(proc.stderr or '').strip()[-500:]}")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            registry = json.loads(line)
+            if "suites" in registry:
+                return registry
+    raise RuntimeError("bench --list emitted no registry JSON")
+
+
+def _run_stage_subprocess(cmd: Sequence[str], timeout_s: float) -> dict:
+    """One suite in its own process group, killed whole on timeout (a
+    wedged env worker must not outlive its suite)."""
+    start = time.monotonic()
+    proc = subprocess.Popen(
+        list(cmd), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, 9)
+        except (OSError, ProcessLookupError):
+            proc.kill()
+        stdout, stderr = proc.communicate()
+    return {"rc": proc.returncode, "stdout": stdout or "",
+            "stderr": stderr or "", "timed_out": timed_out,
+            "wall_s": round(time.monotonic() - start, 1)}
+
+
+def _stage_record(name: str, run: dict, emitted: Optional[dict],
+                  context_before: dict) -> Tuple[dict, dict]:
+    """Classify one suite subprocess into a stage record + the new
+    metric keys it contributed."""
+    record = {"status": "ok", "wall_s": run["wall_s"], "rc": run["rc"],
+              "error": None, "errors": [], "warnings": [], "data": {}}
+    if run["timed_out"]:
+        record["status"] = "timeout"
+        record["error"] = (f"suite exceeded its {run['wall_s']:.0f}s "
+                           f"timeout and was killed")
+        return record, {}
+    if emitted is None:
+        record["status"] = "failed"
+        record["error"] = (
+            f"rc={run['rc']}; no JSON emitted; stderr tail: "
+            f"{run['stderr'].strip()[-500:]}")
+        return record, {}
+    stage_errors = emitted.get("errors") or []
+    stage_warnings = emitted.get("warnings") or []
+    record["errors"] = stage_errors
+    record["warnings"] = stage_warnings
+    crashed = [e for e in stage_errors
+               if e.startswith(f"{name} failed")]
+    if run["rc"] != 0:
+        record["status"] = "failed"
+        record["error"] = (f"rc={run['rc']}: "
+                           f"{run['stderr'].strip()[-500:]}")
+    elif crashed:
+        record["status"] = "failed"
+        record["error"] = crashed[0]
+    data = {key: value for key, value in emitted.items()
+            if key not in _BOOKKEEPING_KEYS
+            and (key not in context_before
+                 or context_before[key] != value)}
+    record["data"] = data
+    return record, data
+
+
+def run_round(bench_dir: Optional[str] = None,
+              suites: Optional[Sequence[str]] = None,
+              round_number: Optional[int] = None,
+              out_path: Optional[str] = None,
+              bench_cmd: Optional[Sequence[str]] = None,
+              timeout_scale: float = 1.0,
+              crash: Optional[str] = None,
+              crash_hard: Optional[str] = None,
+              log=None) -> dict:
+    """Orchestrate one bench round as isolated per-suite subprocesses.
+
+    Returns ``{"path", "artifact", "ok"}``.  The artifact is ALWAYS
+    written (atomically), whatever individual suites did — that is the
+    point.  ``suites`` restricts to a subset and merges onto the newest
+    schema-v1 artifact when one exists; ``crash``/``crash_hard`` thread
+    the bench's fault-injection flags through for acceptance proofs."""
+    bench_dir = os.path.abspath(bench_dir or default_bench_dir())
+    log = log or (lambda message: print(message, file=sys.stderr))
+    bench_cmd = list(bench_cmd or
+                     [sys.executable, os.path.join(bench_dir, "bench.py")])
+    registry = load_registry(bench_cmd)
+    suite_specs = {spec["name"]: spec for spec in registry["suites"]}
+    order = [spec["name"] for spec in registry["suites"]] + [GUARDS_STAGE]
+
+    if suites:
+        unknown = [name for name in suites if name not in order]
+        if unknown:
+            raise ValueError(
+                f"unknown suites {unknown}; known: {order}")
+        selected = [name for name in order if name in set(suites)]
+    else:
+        selected = order
+
+    # Merge target: a subset re-run lands on the newest schema-v1
+    # artifact (so one failed suite is re-run alone); anything else
+    # starts a fresh round.
+    existing = None
+    found = discover_artifacts(bench_dir)
+    if suites and out_path is None and found:
+        candidate = parse_bench_artifact(found[-1][1])
+        if candidate.kind == "round_v1":
+            existing = candidate
+            out_path = candidate.path
+    if round_number is None:
+        round_number = ((existing.raw.get("round") or existing.round)
+                        if existing
+                        else (found[-1][0] if found else 0) + 1)
+    if out_path is None:
+        out_path = os.path.join(bench_dir,
+                                f"BENCH_r{round_number:02d}.json")
+
+    stages: Dict[str, dict] = dict((existing.raw.get("stages") or {})
+                                   if existing else {})
+    guard_summary = (existing.raw.get("guard_summary")
+                     if existing else None)
+    # Context = everything already known from stages NOT being re-run.
+    context: Dict[str, object] = {}
+    for name in order:
+        if name in selected:
+            continue
+        record = stages.get(name)
+        if isinstance(record, dict):
+            context.update(record.get("data") or {})
+
+    tmp_dir = tempfile.mkdtemp(prefix="rounds_run_")
+    try:
+        for name in selected:
+            spec = suite_specs.get(name)
+            timeout_s = (float(spec["timeout_s"]) if spec
+                         else GUARDS_TIMEOUT_S) * timeout_scale
+            context_file = os.path.join(tmp_dir, f"ctx_{name}.json")
+            json_out = os.path.join(tmp_dir, f"out_{name}.json")
+            with open(context_file, "w") as handle:
+                json.dump(context, handle)
+            cmd = bench_cmd + [f"--suites={name}",
+                               f"--context={context_file}",
+                               f"--json_out={json_out}",
+                               # Guards must compare against THIS
+                               # round directory's artifacts, minus
+                               # the round artifact being written (a
+                               # subset re-run would otherwise grade
+                               # the round against itself and disarm
+                               # every cross-round check).
+                               f"--bench_dir={bench_dir}",
+                               "--guard_exclude="
+                               + os.path.basename(out_path)]
+            if crash == name:
+                cmd.append(f"--crash={name}")
+            if crash_hard == name:
+                cmd.append(f"--crash_hard={name}")
+            log(f"[rounds] {name}: running (timeout {timeout_s:.0f}s)")
+            run = _run_stage_subprocess(cmd, timeout_s)
+            emitted = None
+            if os.path.exists(json_out):
+                try:
+                    emitted = json.load(open(json_out))
+                except (OSError, ValueError):
+                    emitted = None
+            if emitted is None:
+                for line in reversed(run["stdout"].splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            emitted = json.loads(line)
+                            break
+                        except ValueError:
+                            continue
+            record, data = _stage_record(name, run, emitted, context)
+            if (name == GUARDS_STAGE and record["status"] == "ok"
+                    and record["errors"]):
+                # A binding guard breach fails the round — guard
+                # errors land in the emitted errors list, not as a
+                # crash, so classify them here.
+                record["status"] = "failed"
+                record["error"] = (
+                    f"{len(record['errors'])} guard error(s), first: "
+                    f"{record['errors'][0]}")
+            stages[name] = record
+            context.update(data)
+            if name == GUARDS_STAGE and emitted is not None:
+                guard_summary = emitted.get("guard_summary")
+            log(f"[rounds] {name}: {record['status']} "
+                f"({record['wall_s']:.0f}s)")
+    finally:
+        try:
+            import shutil
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+        except OSError:
+            pass
+
+    # Rebuild the flat merged dict in registry order so a re-run
+    # suite's stale values are replaced, and aggregate every stage's
+    # errors/warnings with their provenance.
+    merged: Dict[str, object] = {}
+    merged_errors: List[str] = []
+    merged_warnings: List[str] = []
+    for name in order:
+        record = stages.get(name)
+        if not isinstance(record, dict):
+            continue
+        merged.update(record.get("data") or {})
+        for error in record.get("errors") or []:
+            merged_errors.append(f"[{name}] {error}")
+        if record.get("error") and record["status"] != "ok":
+            merged_errors.append(
+                f"[{name}] stage {record['status']}: {record['error']}")
+        for warning in record.get("warnings") or []:
+            merged_warnings.append(f"[{name}] {warning}")
+    merged["errors"] = merged_errors
+    if merged_warnings:
+        merged["warnings"] = merged_warnings
+
+    fingerprint = dict((existing.raw.get("fingerprint") or {})
+                       if existing else {})
+    fingerprint.update(environment_fingerprint(bench_dir))
+    for key in _FINGERPRINT_FROM_RUN:
+        if key in merged:
+            fingerprint[key] = merged[key]
+
+    artifact = {
+        "schema_version": SCHEMA_VERSION,
+        "round": round_number,
+        "created_unix": ((existing.raw.get("created_unix")
+                          if existing else None)
+                         or round(time.time(), 1)),
+        "updated_unix": round(time.time(), 1),
+        "fingerprint": fingerprint,
+        "suite_order": order,
+        "stages": stages,
+        "merged": merged,
+        "guard_summary": guard_summary,
+    }
+    _atomic_write_json(out_path, artifact)
+    run_stages = {name: stages[name] for name in selected
+                  if name in stages}
+    ok = all(record["status"] == "ok"
+             for record in run_stages.values())
+    log(f"[rounds] artifact written: {out_path} "
+        f"({'all stages ok' if ok else 'SOME STAGES FAILED'})")
+    return {"path": out_path, "artifact": artifact, "ok": ok}
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scalable_agent_tpu.obs.rounds",
+        description="Bench-round orchestrator (isolated per-suite "
+                    "subprocesses -> one schema-versioned artifact), "
+                    "longitudinal trajectory + acceptance-scoreboard "
+                    "report, and committed-artifact validator.  "
+                    "jax-free.")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run_parser = sub.add_parser(
+        "run", help="run a bench round as isolated suites")
+    run_parser.add_argument(
+        "--suites", default=None,
+        help="comma-separated subset (re-runs merge onto the newest "
+             "schema-v1 artifact); 'guards' is the final guard stage")
+    run_parser.add_argument("--round", type=int, default=None,
+                            help="round number (default: newest + 1)")
+    run_parser.add_argument("--bench_dir", default=None)
+    run_parser.add_argument("--out", default=None,
+                            help="artifact path (default: "
+                                 "<bench_dir>/BENCH_r<NN>.json)")
+    run_parser.add_argument("--bench", default=None,
+                            help="path to bench.py (default: "
+                                 "<bench_dir>/bench.py)")
+    run_parser.add_argument("--timeout_scale", type=float, default=1.0)
+    run_parser.add_argument(
+        "--crash", default=None, metavar="SUITE",
+        help="inject a Python crash into SUITE (stage-isolation proof)")
+    run_parser.add_argument(
+        "--crash_hard", default=None, metavar="SUITE",
+        help="hard-exit the bench process inside SUITE")
+
+    report_parser = sub.add_parser(
+        "report", help="render the cross-round trajectory + scoreboard")
+    report_parser.add_argument("--json", action="store_true")
+    report_parser.add_argument("--bench_dir", default=None)
+
+    validate_parser = sub.add_parser(
+        "validate", help="truncation/schema check over every artifact")
+    validate_parser.add_argument("--json", action="store_true")
+    validate_parser.add_argument("--bench_dir", default=None)
+    validate_parser.add_argument(
+        "--write_salvage", action="store_true",
+        help="write/refresh .salvage.json sidecars for truncated "
+             "artifacts instead of erroring on them")
+
+    args = parser.parse_args(argv)
+    if args.command == "run":
+        bench_cmd = ([sys.executable, args.bench] if args.bench
+                     else None)
+        suites = ([name for name in args.suites.split(",") if name]
+                  if args.suites else None)
+        try:
+            outcome = run_round(
+                bench_dir=args.bench_dir, suites=suites,
+                round_number=args.round, out_path=args.out,
+                bench_cmd=bench_cmd, timeout_scale=args.timeout_scale,
+                crash=args.crash, crash_hard=args.crash_hard)
+        except (ValueError, RuntimeError,
+                subprocess.SubprocessError) as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
+        print(outcome["path"])
+        return 0 if outcome["ok"] else 1
+    if args.command == "report":
+        trajectory = build_trajectory(args.bench_dir)
+        if args.json:
+            print(json.dumps(trajectory, indent=1))
+        else:
+            print(render_trajectory(trajectory), end="")
+        return 0
+    result = validate_artifacts(args.bench_dir,
+                                write_salvage=args.write_salvage)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(render_validation(result), end="")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

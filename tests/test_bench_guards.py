@@ -770,3 +770,96 @@ class TestKernelRegressionGuard:
         bench.kernel_regression_guard(diag)
         assert not [e for e in diag["errors"]
                     if "KERNEL REGRESSION" in e]
+
+
+class TestGuardRegistry:
+    """ISSUE 14 unification: the ~12 regression guards live on ONE
+    registry with one binding-vs-advisory policy table and a single
+    end-of-round guard summary."""
+
+    def test_registry_covers_every_guard_function(self):
+        """A new *_regression_guard function that is not registered
+        would silently never run in a round."""
+        functions = {name for name, obj in vars(bench).items()
+                     if callable(obj)
+                     and name.endswith("_regression_guard")}
+        functions.add("regression_guard")
+        assert {spec.name for spec in bench.GUARD_REGISTRY} == functions
+
+    def test_every_policy_is_in_the_table(self):
+        assert {spec.policy for spec in bench.GUARD_REGISTRY} <= set(
+            bench.GUARD_POLICIES)
+
+    def test_guard_flag_routes_by_policy_and_platform(self):
+        diag = {"errors": [], "platform": "cpu"}
+        bench.guard_flag(diag, "X", policy="binding")
+        assert diag["errors"] == ["X"] and "warnings" not in diag
+
+        diag = {"errors": [], "platform": "cpu"}
+        bench.guard_flag(diag, "Y")  # tpu_binding on the CPU fallback
+        assert diag["errors"] == []
+        assert diag["warnings"] == ["Y — CPU fallback: advisory"]
+
+        diag = {"errors": [], "platform": "tpu"}
+        bench.guard_flag(diag, "Z")
+        assert diag["errors"] == ["Z"]
+
+        diag = {"errors": [], "platform": "tpu"}
+        bench.guard_flag(diag, "W", policy="advisory")
+        assert diag["errors"] == [] and diag["warnings"] == ["W"]
+
+    def test_run_guards_produces_the_summary(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "resilience_finite_check_frac": 0.05}
+        summary = bench.run_guards({"value": 0.0}, diag,
+                                   bench_dir=str(tmp_path))
+        assert set(summary) == {spec.name
+                                for spec in bench.GUARD_REGISTRY}
+        assert summary["resilience_regression_guard"]["status"] == (
+            "failed")
+        assert summary["resilience_regression_guard"]["errors"] == 1
+        assert summary["fleet_regression_guard"]["status"] == "ok"
+        assert all(entry["policy"] in bench.GUARD_POLICIES
+                   for entry in summary.values())
+        assert diag["guard_summary"] is summary
+
+    def test_run_guards_exclude_skips_the_named_artifact(
+            self, tmp_path):
+        """The orchestrator excludes the round artifact being merged
+        onto: the guards must then compare against the artifact BELOW
+        it, not the round itself (self-comparison disarms every
+        cross-round check)."""
+        write = __import__("json").dumps
+        (tmp_path / "BENCH_r01.json").write_text(write(
+            {"metric": "m", "platform": "tpu",
+             "kernel_alpha_us": 1.0}))
+        (tmp_path / "BENCH_r02.json").write_text(write(
+            {"metric": "m", "platform": "tpu",
+             "kernel_beta_us": 1.0}))
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_alpha_us": 1.1}  # beta missing
+        bench.run_guards({}, diag, bench_dir=str(tmp_path),
+                         exclude=("BENCH_r02.json",))
+        assert not any("kernel_beta_us" in e for e in diag["errors"])
+        # Without the exclusion the same diag IS held to r02's keys.
+        diag2 = {"errors": [], "platform": "tpu",
+                 "kernel_alpha_us": 1.1}
+        bench.run_guards({}, diag2, bench_dir=str(tmp_path))
+        assert any("kernel_beta_us" in e and "missing" in e
+                   for e in diag2["errors"])
+
+    def test_run_guards_contains_a_crashing_guard(self, monkeypatch,
+                                                  tmp_path):
+        def boom(result, diag, bench_dir):
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(
+            bench, "GUARD_REGISTRY",
+            (bench.GuardSpec("boom_guard", boom, "binding", "x"),)
+            + bench.GUARD_REGISTRY)
+        diag = {"errors": [], "platform": "cpu"}
+        summary = bench.run_guards({}, diag, bench_dir=str(tmp_path))
+        assert summary["boom_guard"]["status"] == "crashed"
+        assert any("boom_guard failed" in e for e in diag["errors"])
+        # The rest of the registry still ran after the crash.
+        assert summary["elastic_regression_guard"]["status"] == "ok"
