@@ -964,6 +964,189 @@ def bench_ingraph(diag, budget_s=90.0):
         loss / (unroll_len * batch), 3)
 
 
+def _device_e2e_fps(level, updates_per_dispatch, unroll_len, batch,
+                    min_updates, min_seconds, deadline):
+    """Fused e2e fps of one device level at one megaloop K — the
+    bench_device_env helper.  Returns (fps, updates_measured)."""
+    import jax
+
+    from scalable_agent_tpu.envs.device import make_device_env
+    from scalable_agent_tpu.models import ImpalaAgent
+    from scalable_agent_tpu.parallel import MeshSpec, make_mesh
+    from scalable_agent_tpu.runtime import (
+        InGraphTrainer, Learner, LearnerHyperparams)
+
+    env = make_device_env(level)
+    agent = ImpalaAgent(num_actions=env.num_actions)
+    mesh = make_mesh(MeshSpec(data=1, model=1), devices=jax.devices()[:1])
+    learner = Learner(agent, LearnerHyperparams(), mesh,
+                      frames_per_update=unroll_len * batch)
+    trainer = InGraphTrainer(agent, learner, env, unroll_len, batch,
+                             seed=0,
+                             updates_per_dispatch=updates_per_dispatch)
+    state, carry = trainer.init(jax.random.key(0))
+    k = updates_per_dispatch
+    # Pay the compile + one steady dispatch before timing.
+    state, carry, metrics = trainer.run(state, carry, k)
+    _fetch_scalar(metrics["total_loss"])
+    updates, counter = 0, k
+    t0 = time.perf_counter()
+    while ((updates < min_updates
+            or time.perf_counter() - t0 < min_seconds)
+           and time.perf_counter() < deadline):
+        state, carry, metrics = trainer.run(
+            state, carry, k, counter_start=counter)
+        updates += k
+        counter += k
+    _fetch_scalar(metrics["total_loss"])
+    dt = time.perf_counter() - t0
+    return updates * unroll_len * batch / dt, updates
+
+
+def bench_device_env(diag, budget_s=240.0):
+    """The device-env suite (ISSUE 15): per-level raw batched env-step
+    rate for every DEVICE_LEVELS entry, fused e2e fps on the REAL
+    worlds (device_grid_small, device_minatar_breakout) at megaloop
+    K ∈ {1, 8}, and the dispatch-amortization curve — so the r06
+    ``device_env_e2e_vs_baseline`` criterion is graded on a world that
+    does actual work, not the zero-simulator-cost fake."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalable_agent_tpu.envs.device import (
+        device_level_names, make_device_env)
+
+    t_start = time.perf_counter()
+    deadline = t_start + budget_s
+    cpu = diag.get("platform") == "cpu"
+    step_b, step_t = (64, 32) if cpu else (256, 64)
+
+    # -- raw batched env-step rate, per registered level -------------------
+    for name in device_level_names():
+        if time.perf_counter() > deadline:
+            diag["errors"].append(
+                f"bench_device_env hit its {budget_s:.0f}s budget "
+                f"before level {name}")
+            break
+        env = make_device_env(name)
+        max_seed = int(getattr(env, "max_seed", 2**31 - 1))
+        seeds = (np.arange(step_b, dtype=np.int64) % (max_seed + 1)
+                 ).astype(np.int32)
+        state, _ = env.initial(seeds)
+        rng = np.random.default_rng(0)
+        actions = jnp.asarray(rng.integers(
+            0, env.num_actions, size=(step_t, step_b)).astype(np.int32))
+
+        def run(state, actions):
+            return jax.lax.scan(env.step, state, actions)[0]
+
+        run_jit = jax.jit(run)
+        state = jax.block_until_ready(run_jit(state, actions))  # compile
+        iters = 0
+        t0 = time.perf_counter()
+        while (iters < 3 or time.perf_counter() - t0 < 1.0) \
+                and time.perf_counter() < deadline:
+            state = run_jit(state, actions)
+            iters += 1
+        if not iters:
+            # The deadline expired inside this level's compile: a 0.0
+            # "rate" would poison the committed floor the regression
+            # guard compares against — record the exhaustion instead.
+            diag["errors"].append(
+                f"bench_device_env budget exhausted measuring "
+                f"step rate for {name}")
+            break
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        diag[f"device_env_step_rate_{name}"] = round(
+            iters * step_t * step_b / dt, 1)
+
+    # -- fused e2e on the real worlds at K in {1, 8} -----------------------
+    e2e_t, e2e_b = (16, 16) if cpu else (100, 32)
+    min_updates, min_seconds = (8, 2.0) if cpu else (30, 8.0)
+    best = 0.0
+    curve = []
+    exhausted = False
+    for level, short in (("device_grid_small", "grid_small"),
+                         ("device_minatar_breakout", "breakout")):
+        if exhausted:
+            break
+        for k in (1, 8):
+            if time.perf_counter() > deadline:
+                diag["errors"].append(
+                    f"bench_device_env budget exhausted before "
+                    f"{level} K={k}")
+                exhausted = True
+                break
+            fps, measured = _device_e2e_fps(
+                level, k, e2e_t, e2e_b, min_updates, min_seconds,
+                deadline)
+            if not measured:  # deadline hit before one timed dispatch
+                diag["errors"].append(
+                    f"bench_device_env budget exhausted measuring "
+                    f"{level} K={k}")
+                exhausted = True
+                break
+            diag[f"device_env_e2e_{short}_k{k}_fps"] = round(fps, 1)
+            best = max(best, fps)
+            if level == "device_grid_small":
+                curve.append([k, round(fps, 1)])
+    # Dispatch-amortization curve: fill the middle K points on the
+    # gridworld while budget remains (endpoints reuse the K=1/8 runs;
+    # the headroom check keeps a compile-only point from reading 0).
+    headroom = 15.0 if cpu else 45.0
+    for k in (2, 4):
+        if time.perf_counter() > deadline - headroom:
+            break
+        fps, measured = _device_e2e_fps(
+            "device_grid_small", k, e2e_t, e2e_b, min_updates,
+            min_seconds, deadline)
+        if measured:
+            curve.append([k, round(fps, 1)])
+    diag["device_env_dispatch_curve"] = sorted(curve)  # [[K, fps]]
+    if best:
+        # The r06 scoreboard key: device-resident e2e on a REAL world
+        # vs the 30k fps host baseline (obs/rounds.py R06_TARGETS).
+        diag["device_env_e2e_vs_baseline"] = round(
+            best / BASELINE_FPS, 3)
+
+
+# The diag keys device_env_regression_guard compares round-over-round.
+DEVICE_ENV_GUARD_PREFIXES = ("device_env_step_rate_", "device_env_e2e_")
+
+
+def device_env_regression_guard(diag, bench_dir=None):
+    """Step-rate floor: any device-env step rate or fused e2e reading
+    below 50% of the newest committed artifact's — or missing while
+    the artifact has it — flags (binding on TPU, advisory on the CPU
+    fallback where host scheduling dominates)."""
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key, old in sorted(prev.items()):
+        if not key.startswith(DEVICE_ENV_GUARD_PREFIXES):
+            continue
+        if key == "device_env_e2e_vs_baseline":
+            # Derived ratio (best fps / BASELINE_FPS): it moves with
+            # the fps keys already guarded, and a BASELINE_FPS revision
+            # would shift it with no device-side change.
+            continue
+        if not isinstance(old, (int, float)) or isinstance(old, bool) \
+                or not old:
+            continue
+        cur = diag.get(key)
+        if not isinstance(cur, (int, float)):
+            guard_flag(diag,
+                       f"DEVICE ENV REGRESSION: {key} missing this "
+                       f"round (previous round: {old}, {ref_name})")
+        elif cur < old * 0.5:
+            guard_flag(diag,
+                       f"DEVICE ENV REGRESSION: {key} {cur} is below "
+                       f"50% of the previous round's {old} "
+                       f"({ref_name})")
+
+
 def bench_learning(diag, budget_s=120.0):
     """Learning proof on the real backend: the fused in-graph trainer on
     ``fake_bandit`` (envs/fake.py reward_mode docs — uniform-random
@@ -2640,6 +2823,11 @@ SUITE_REGISTRY = (
                   diag, budget_s=_suite_budget(diag, 90.0, 15.0)), 600,
               "fused in-graph rollout+update e2e fps (device-resident "
               "env)"),
+    SuiteSpec("bench_device_env",
+              lambda result, diag, ctx: bench_device_env(
+                  diag, budget_s=_suite_budget(diag, 240.0, 90.0)), 900,
+              "device-env suite: per-level step rates, fused e2e at "
+              "K={1,8}, dispatch-amortization curve"),
     SuiteSpec("bench_learning",
               lambda result, diag, ctx: bench_learning(
                   diag, budget_s=_suite_budget(diag, 120.0, 90.0)), 600,
@@ -2767,6 +2955,11 @@ GUARD_REGISTRY = (
               lambda result, diag, bench_dir: devtel_regression_guard(
                   diag, bench_dir), "tpu_binding",
               "device telemetry < 1% of the update stage"),
+    GuardSpec("device_env_regression_guard",
+              lambda result, diag, bench_dir: device_env_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "device-env step rates + fused e2e >= 50% of the newest "
+              "artifact; a published key going missing flags too"),
     GuardSpec("kernel_regression_guard",
               lambda result, diag, bench_dir: kernel_regression_guard(
                   diag, bench_dir), "tpu_binding",

@@ -129,6 +129,15 @@ class Config:
     # device-expressible levels, runtime/ingraph.py — zero per-step
     # host↔device traffic).
     train_backend: str = "host"
+    # ingraph only: fused updates per device dispatch (the multi-update
+    # megaloop, runtime/ingraph.py).  K > 1 runs K rollout+update
+    # iterations as ONE lax.scan per launch, so a cheap-env run is no
+    # longer dispatch-bound — bit-exact with K dispatches of 1 over the
+    # same total update count.  Checkpoint/log/preemption decisions
+    # land on dispatch boundaries (granularity K updates).
+    # Incompatible with replay_ratio > 0 (replayed updates interleave
+    # between dispatches).
+    updates_per_dispatch: int = 1
     # Trajectory transport (runtime/transport.py): "packed" flattens
     # every trajectory leaf into ONE contiguous staging buffer per batch
     # (dtype-segmented, 128-byte-aligned offsets) so a batch costs a

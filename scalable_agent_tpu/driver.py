@@ -538,7 +538,8 @@ def _harvest_kernel_ledger(config: Config, lower_fn,
         config.logdir)
 
 
-def _configure_live_mfu(ledger, lower_fn, num_devices: int):
+def _configure_live_mfu(ledger, lower_fn, num_devices: int,
+                        updates_per_execution: int = 1):
     """Arm the ledger's live ``ledger/mfu`` gauge (obs/ledger.py).
 
     FLOPs per update come from the LOWERED (uncompiled) update
@@ -549,7 +550,13 @@ def _configure_live_mfu(ledger, lower_fn, num_devices: int):
     Skipped when the chip's peak is unknown (the CPU fallback — the
     gauge then stays at 0, and no test pays the lowering); the
     SCALABLE_AGENT_LEDGER_MFU_PEAK env var overrides the peak so the
-    full path is exercisable anywhere."""
+    full path is exercisable anywhere.
+
+    ``updates_per_execution``: the in-graph megaloop runs K updates
+    per dispatched program, but XLA's cost analysis counts a lax.scan
+    body ONCE regardless of trip count — so the lowered flops cover
+    one update while a retired ledger record covers K; the gauge
+    scales the numerator by K to stay honest."""
     peak = _resolve_roofline_peak()
     if not peak:
         return
@@ -562,9 +569,10 @@ def _configure_live_mfu(ledger, lower_fn, num_devices: int):
         log.info("live MFU gauge disabled (cost analysis failed): %s",
                  exc)
         return
+    flops *= max(1, int(updates_per_execution))
     if flops > 0:
         ledger.configure_mfu(flops, peak, num_devices)
-        log.info("live MFU gauge armed: %.3g flops/update against "
+        log.info("live MFU gauge armed: %.3g flops/record against "
                  "%.3g peak flops/s x %d device(s)",
                  flops, peak, num_devices)
 
@@ -1368,6 +1376,16 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
         raise ValueError(
             f"inflight_updates must be >= 1, got "
             f"{config.inflight_updates}")
+    if config.updates_per_dispatch < 1:
+        raise ValueError(
+            f"updates_per_dispatch must be >= 1, got "
+            f"{config.updates_per_dispatch}")
+    if (config.updates_per_dispatch > 1
+            and config.train_backend != "ingraph"):
+        raise ValueError(
+            "--updates_per_dispatch is the in-graph megaloop knob "
+            "(train_backend=ingraph); the host backend pipelines via "
+            "--inflight_updates instead")
     if config.loss not in ("vtrace", "impact"):
         raise ValueError(
             f"unknown loss {config.loss!r} (vtrace | impact)")
@@ -1458,8 +1476,9 @@ _INGRAPH_PENDING_CAP = 2048
 
 def train_ingraph(config: Config) -> Dict[str, float]:
     """Fused in-graph training: rollout + update as ONE jitted device
-    program per update (runtime/ingraph.py), for levels whose simulator
-    is expressible in XLA (envs/device.py).
+    program per dispatch (runtime/ingraph.py — K = updates_per_dispatch
+    fused updates per launch), for levels whose simulator is
+    expressible in XLA (envs/device/, the DEVICE_LEVELS registry).
 
     Checkpoint cadence, metrics names, LR schedule, and resume semantics
     match the host loop exactly — the two backends share the Learner and
@@ -1485,13 +1504,20 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         raise ValueError(
             "train_backend=ingraph has no host actor pipeline; "
             "--actor=service applies to the host backend")
+    if config.replay_ratio > 0 and config.updates_per_dispatch > 1:
+        raise ValueError(
+            "replay_ratio > 0 requires --updates_per_dispatch=1: "
+            "replayed updates interleave with fresh ones between "
+            "dispatches (runtime/ingraph.py)")
     config = apply_env_overrides(config)
     config.save()
     configure_faults(config.chaos_spec)  # disarmed again in the finally
 
     # Probe the HOST twin of the level so action/observation specs stay
-    # in lock-step with the device mirror (they are asserted
-    # interchangeable in tests/test_device_env.py).
+    # in lock-step with the device env.  For the fake family the twin
+    # is the mirrored envs/fake.py implementation; for device-native
+    # levels (device_*) it is the HostDeviceEnv adapter driving the
+    # same transition function, so agreement is by construction.
     observation_spec, action_space, _ = probe_env(config)
     agent = build_agent(config, action_space)
     env = make_device_env(
@@ -1507,12 +1533,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         raise ValueError(
             f"host/device observation drift: host frame {host_frame} "
             f"!= device mirror {device_frame} (envs/fake.py and "
-            f"envs/device.py must stay in lock-step)")
+            f"envs/device/ must stay in lock-step)")
 
     learner = build_training_learner(config, agent)
-    trainer = InGraphTrainer(agent, learner, env, config.unroll_length,
-                             config.batch_size, seed=config.seed,
-                             emit_trajectory=config.replay_ratio > 0)
+    trainer = InGraphTrainer(
+        agent, learner, env, config.unroll_length,
+        config.batch_size, seed=config.seed,
+        emit_trajectory=config.replay_ratio > 0,
+        updates_per_dispatch=config.updates_per_dispatch)
     # Device replay for the fused backend: the unroll's device-born
     # Trajectory pytree goes straight into the slab (no transport in
     # this backend, so no packed buffer to store — the per-leaf slabs
@@ -1544,7 +1572,12 @@ def train_ingraph(config: Config) -> Dict[str, float]:
 
     timing = Timing()
     updates = start_updates
-    frames_per_update = config.frames_per_update()
+    # One dispatch = K fused updates (the megaloop): the host loop's
+    # counters, ledger records, and checkpoint/preemption decisions all
+    # advance at dispatch granularity.
+    updates_per_dispatch = config.updates_per_dispatch
+    frames_per_dispatch = (config.frames_per_update()
+                           * updates_per_dispatch)
     frames = _host_scalar(state.env_frames)
     last_log = time.monotonic()
     frames_at_last_log = frames
@@ -1574,13 +1607,17 @@ def train_ingraph(config: Config) -> Dict[str, float]:
     # and the retire rate drives the live MFU gauge honestly.
     ledger = configure_ledger(
         registry=registry,
-        frames_per_trajectory=config.frames_per_update(),
+        # One ledger record per DISPATCH: its frame volume is the K
+        # fused updates' worth, so retire-rate-derived MFU and fps stay
+        # honest under the megaloop.
+        frames_per_trajectory=frames_per_dispatch,
         logdir=config.logdir,
         process_index=0)
     _configure_live_mfu(
         ledger,
         lambda: trainer.train_step.lower(state, carry, np.int32(0)),
-        learner.mesh.devices.size)
+        learner.mesh.devices.size,
+        updates_per_execution=updates_per_dispatch)
     profiling = False
     profile_stop_at = None
     if restored is not None:
@@ -1599,10 +1636,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             pending_tids: List[int] = []
             while frames < config.total_environment_frames:
                 if (config.profile_dir and not profiling
+                        and profile_stop_at is None
                         and updates - start_updates
-                        == config.profile_start_update):
+                        >= config.profile_start_update):
                     # Same --profile_dir window as the host backend —
-                    # the capture the kernel ledger joins below.
+                    # the capture the kernel ledger joins below.  >=,
+                    # not ==: the megaloop advances ``updates`` in
+                    # strides of K, which need not land exactly on
+                    # profile_start_update (the one-shot gate is the
+                    # still-None profile_stop_at).
                     jax.profiler.start_trace(config.profile_dir)
                     get_tracer().set_annotate(True)
                     profiling = True
@@ -1662,8 +1704,8 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         ledger.close(tid, retired=True)
                     pending_tids.clear()
                 watchdog.touch("learner")
-                updates += 1
-                frames += frames_per_update
+                updates += updates_per_dispatch
+                frames += frames_per_dispatch
                 if profiling and updates >= profile_stop_at:
                     jax.block_until_ready(metrics["total_loss"])
                     # The sync above materialized every pending
@@ -1683,11 +1725,21 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # compile (multi-minute on TPU) — the loop's touch
                     # below re-arms.
                     watchdog.suspend("learner")
+                    # ``executions`` is the UPDATE count in the trace
+                    # window: XLA's cost analysis counts a lax.scan
+                    # body once regardless of trip count (verified:
+                    # K=8 lowers to ~the K=1 flops), so flops_total ≈
+                    # one update's flops — and the window runs whole
+                    # dispatches, ceil(profile_num_updates / K) of
+                    # them, each K updates' device time.
+                    profiled_dispatches = -(-config.profile_num_updates
+                                            // updates_per_dispatch)
                     _harvest_kernel_ledger(
                         config,
                         lambda: trainer.train_step.lower(
                             state, carry, np.int32(0)),
-                        executions=config.profile_num_updates)
+                        executions=(profiled_dispatches
+                                    * updates_per_dispatch))
                 now = time.monotonic()
                 if now - last_log >= config.log_interval_s:
                     host_metrics = _finalize_ingraph_metrics(

@@ -1,0 +1,59 @@
+"""Device-resident episode accounting shared by every device env.
+
+The host pipeline's episodes surface through MultiEnv ring buffers; a
+device env's episodes would otherwise surface ONLY through the fused
+step's metrics dict — invisible to the registry/prom/report plane.
+These instruments ride the fused program's donated telemetry pytree
+(obs/device_telemetry.py) instead: counters for finished episodes and
+agent steps, and bucketed return/length histograms whose exact
+sum/count give exact means at any bucket resolution — fetched once per
+log interval, published as ``devtel/env/*``.
+"""
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from scalable_agent_tpu.obs.device_telemetry import DeviceTelemetry
+from scalable_agent_tpu.types import StepOutput
+
+__all__ = ["env_telemetry_spec", "record_episode_telemetry"]
+
+
+def env_telemetry_spec() -> DeviceTelemetry:
+    """The one ``devtel/env/*`` instrument set (see module docstring)."""
+    return (
+        DeviceTelemetry("env")
+        .counter("episodes", "episodes finished on device")
+        .counter("steps", "agent steps executed on device")
+        .histogram(
+            "episode_return",
+            (-10.0, -1.0, 0.0, 1.0, 2.0, 5.0, 10.0, 30.0, 100.0),
+            "per-episode return at episode end (emitted accounting)")
+        .histogram(
+            "episode_length",
+            (5.0, 10.0, 30.0, 100.0, 300.0, 1000.0, 3000.0),
+            "per-episode agent steps at episode end")
+    )
+
+
+def record_episode_telemetry(spec: DeviceTelemetry, tel: Dict,
+                             env_outputs: StepOutput) -> Dict:
+    """Fold a ``[T, B]`` (or ``[B]``) StepOutput sequence into the env
+    telemetry — pure jnp, safe inside the fused jitted step.
+
+    Episode-end detection matches the fused trainer's metrics
+    accounting exactly (runtime/ingraph.py): ``done & episode_step >
+    0`` — the initial-reset ``done=True`` rows carry step 0 and must
+    not count as finished episodes."""
+    done = env_outputs.done
+    steps = env_outputs.info.episode_step
+    finished = jnp.logical_and(done, steps > 0)
+    tel = spec.inc(tel, "episodes",
+                   finished.sum().astype(jnp.float32))
+    tel = spec.inc(tel, "steps", jnp.float32(done.size))
+    tel = spec.observe(tel, "episode_return",
+                       env_outputs.info.episode_return, where=finished)
+    tel = spec.observe(tel, "episode_length",
+                       steps.astype(jnp.float32), where=finished)
+    return tel

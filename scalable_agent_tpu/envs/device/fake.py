@@ -1,15 +1,4 @@
-"""In-graph (on-device) environments: envs as pure XLA functions.
-
-The reference steps its environments *inside* the TF graph through
-``tf.py_func`` pipes to subprocesses (reference: py_process.py:97-112,
-environments.py:149-233) — the graph stalls on the host every step.  The
-TPU-native inversion: an environment whose transition function is
-expressible in XLA runs ON the accelerator, vectorized over the batch,
-inside the same jitted program as agent inference — an entire unroll (or
-the whole train step) becomes ONE device launch with zero per-step
-host↔device traffic.  This is the standard JAX-RL architecture
-(gymnax/Brax-style) and is what lets the framework saturate a chip whose
-host link is slow (e.g. a remote TPU attachment).
+"""``DeviceFakeEnv``: the [B]-vectorized pure-XLA mirror of envs/fake.py.
 
 ``DeviceFakeEnv`` mirrors the host ``FakeEnv`` (envs/fake.py) transition
 math EXACTLY — same frames, rewards, episode boundaries, auto-reset and
@@ -27,69 +16,22 @@ jittered envs require ``seed < 2**31 / 1000003`` (seed <= 2147).
 ``initial()`` checks the applicable bound.
 """
 
-from typing import Dict, NamedTuple, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scalable_agent_tpu.envs.device.protocol import DeviceEnvSpec
 from scalable_agent_tpu.envs.spaces import Discrete
 from scalable_agent_tpu.envs.spec import TensorSpec
-from scalable_agent_tpu.obs.device_telemetry import DeviceTelemetry
 from scalable_agent_tpu.types import (
     Observation,
     StepOutput,
     StepOutputInfo,
 )
 
-
-def env_telemetry_spec() -> DeviceTelemetry:
-    """Device-resident episode accounting for on-device envs.
-
-    The host pipeline's episodes surface through MultiEnv ring buffers;
-    a device env's episodes previously surfaced ONLY through the fused
-    step's metrics dict — invisible to the registry/prom/report plane.
-    These instruments ride the fused program's donated telemetry pytree
-    (obs/device_telemetry.py) instead: counters for finished episodes
-    and agent steps, and bucketed return/length histograms whose exact
-    sum/count give exact means at any bucket resolution — fetched once
-    per log interval, published as ``devtel/env/*``.
-    """
-    return (
-        DeviceTelemetry("env")
-        .counter("episodes", "episodes finished on device")
-        .counter("steps", "agent steps executed on device")
-        .histogram(
-            "episode_return",
-            (-10.0, -1.0, 0.0, 1.0, 2.0, 5.0, 10.0, 30.0, 100.0),
-            "per-episode return at episode end (emitted accounting)")
-        .histogram(
-            "episode_length",
-            (5.0, 10.0, 30.0, 100.0, 300.0, 1000.0, 3000.0),
-            "per-episode agent steps at episode end")
-    )
-
-
-def record_episode_telemetry(spec: DeviceTelemetry, tel: Dict,
-                             env_outputs: StepOutput) -> Dict:
-    """Fold a ``[T, B]`` (or ``[B]``) StepOutput sequence into the env
-    telemetry — pure jnp, safe inside the fused jitted step.
-
-    Episode-end detection matches the fused trainer's metrics
-    accounting exactly (runtime/ingraph.py): ``done & episode_step >
-    0`` — the initial-reset ``done=True`` rows carry step 0 and must
-    not count as finished episodes."""
-    done = env_outputs.done
-    steps = env_outputs.info.episode_step
-    finished = jnp.logical_and(done, steps > 0)
-    tel = spec.inc(tel, "episodes",
-                   finished.sum().astype(jnp.float32))
-    tel = spec.inc(tel, "steps", jnp.float32(done.size))
-    tel = spec.observe(tel, "episode_return",
-                       env_outputs.info.episode_return, where=finished)
-    tel = spec.observe(tel, "episode_length",
-                       steps.astype(jnp.float32), where=finished)
-    return tel
+__all__ = ["DeviceEnvState", "DeviceFakeEnv"]
 
 
 class DeviceEnvState(NamedTuple):
@@ -145,8 +87,15 @@ class DeviceFakeEnv:
         # length-jitter mix still multiplies the raw seed (the host
         # computes ``seed * 1000003`` in bigints) and keeps the tight
         # bound.
-        self._max_seed = ((2**31 - 1) // 1000003 if length_jitter > 0
-                          else 2**31 - 1)
+        self.max_seed = ((2**31 - 1) // 1000003 if length_jitter > 0
+                         else 2**31 - 1)
+
+    @property
+    def spec(self) -> DeviceEnvSpec:
+        return DeviceEnvSpec(
+            observation_spec=self.observation_spec,
+            action_space=self.action_space,
+            num_actions=self.num_actions)
 
     # -- pure transition math (mirrors FakeEnv line by line) ---------------
 
@@ -205,10 +154,10 @@ class DeviceFakeEnv:
         emits reward 0, zero info, done=True ("start of episode")."""
         if not isinstance(seeds, jax.core.Tracer):
             host_seeds = np.asarray(seeds)
-            if (np.abs(host_seeds) > self._max_seed).any():
+            if (np.abs(host_seeds) > self.max_seed).any():
                 raise ValueError(
                     f"device FakeEnv seeds must stay below "
-                    f"{self._max_seed} for exact host-mirror arithmetic")
+                    f"{self.max_seed} for exact host-mirror arithmetic")
         seeds = jnp.asarray(seeds, jnp.int32)
         b = seeds.shape[0]
 
@@ -293,46 +242,3 @@ class DeviceFakeEnv:
                 instruction=None),
         )
         return new_state, output
-
-
-def make_device_env(level_name: str, height: int = 0, width: int = 0,
-                    num_actions: int = 0, num_action_repeats: int = 1,
-                    with_instruction: bool = False,
-                    **kwargs) -> DeviceFakeEnv:
-    """Device-env factory for levels expressible as pure XLA functions
-    (the in-graph training backend, runtime/ingraph.py + driver
-    --train_backend=ingraph).
-
-    Mirrors the host fake-family defaults (envs/registry.py _make_fake)
-    so probe_env's host spec matches the device env exactly.  Levels
-    whose simulators live in external processes (doom_/dmlab_/atari_)
-    cannot run in-graph; asking for one is a clear error, not a silent
-    fallback.
-    """
-    if with_instruction:
-        raise ValueError(
-            "device envs do not emit instruction observations")
-    defaults = {
-        "fake_benchmark": dict(height=72, width=96, episode_length=1000,
-                               num_actions=9),
-        "fake_small": dict(height=16, width=16, episode_length=10,
-                           num_actions=9),
-        "fake_bandit": dict(height=16, width=16, episode_length=16,
-                            num_actions=4, reward_mode="bandit"),
-        "fake_memory": dict(height=16, width=16, episode_length=8,
-                            num_actions=4, reward_mode="memory"),
-    }
-    if level_name not in defaults:
-        raise ValueError(
-            f"level {level_name!r} has no device (in-graph) "
-            f"implementation; device-expressible levels: "
-            f"{sorted(defaults)}")
-    params = dict(defaults[level_name])
-    if height:
-        params["height"] = height
-    if width:
-        params["width"] = width
-    if num_actions:  # 0 = use the level's host-registry default
-        params["num_actions"] = num_actions
-    params.update(kwargs)
-    return DeviceFakeEnv(num_action_repeats=num_action_repeats, **params)

@@ -1,0 +1,197 @@
+"""``DeviceGridWorld``: a procedurally-generated key-door gridworld in XLA.
+
+The first *real* device world (ROADMAP item 1): unlike ``DeviceFakeEnv``
+(whose transition function is a handful of scalar mods — zero simulator
+cost), every step here does actual work — layout hashing, collision
+logic, partial-observation rendering — all expressed as pure ``jnp`` so
+the whole thing batch-vectorizes over ``[B]`` and fuses into the
+in-graph megastep.
+
+World (one episode):
+
+- A ``grid_size x grid_size`` room split by a vertical wall whose
+  column, door row, agent start, key, and goal positions are all hashed
+  from ``(seed, episode)`` — every episode is a fresh layout, every
+  layout solvable by construction (key and agent share the near side,
+  the goal sits behind the wall, the door is always in the wall).
+- Actions: 4 (up / down / left / right).  Moving into the border or the
+  wall is a no-op; the door cell only admits an agent carrying the key.
+- Sparse rewards: +0.5 picking up the key, +0.5 the first pass through
+  the door, +1.0 reaching the goal (terminates).  Episodes also
+  truncate at ``episode_length`` simulator steps.
+- Observation: a ``view x view`` window centered on the agent (cells
+  outside the room render as wall), upscaled ``cell_px`` pixels per
+  cell into the uint8 frame.  Channels: R = walls/door (closed 160,
+  open 64, wall 255), G = key (255) + the agent marker at the center
+  (128, 192 when carrying the key), B = goal (255).
+
+Layout hashing is counter-based (envs/device/world.py), so ANY int32
+seed is valid — there is no host twin whose bigint arithmetic must be
+mirrored (the host-side view of this world is the generic adapter in
+envs/device/host.py, which steps THIS function).
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.envs.device.world import DeviceWorld, _rand_below
+from scalable_agent_tpu.envs.spaces import Discrete
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.types import Observation
+
+__all__ = ["DeviceGridState", "DeviceGridWorld"]
+
+
+class DeviceGridState(NamedTuple):
+    """Per-env state, all [B] (vmapped scalars internally)."""
+
+    seed: jax.Array  # i32, fixed per env
+    episode: jax.Array  # i32
+    step: jax.Array  # i32, simulator step within the episode
+    episode_return: jax.Array  # f32, carried accumulator
+    episode_step: jax.Array  # i32, agent steps within the episode
+    row: jax.Array  # i32 agent position
+    col: jax.Array  # i32
+    has_key: jax.Array  # i32 0/1
+    door_open: jax.Array  # i32 0/1
+
+
+# Action deltas: up, down, left, right.  Kept as numpy (no jax array
+# materialization at import time); use sites lift to jnp so traced
+# actions can index.
+_DROW = np.array([-1, 1, 0, 0], np.int32)
+_DCOL = np.array([0, 0, -1, 1], np.int32)
+
+
+class DeviceGridWorld(DeviceWorld):
+    """See module docstring.  ``initial``/``step`` follow the DeviceEnv
+    protocol (envs/device/protocol.py): pure jnp, auto-reset, emitted-
+    vs-carried episode accounting."""
+
+    num_channels = 3
+
+    def __init__(self, grid_size: int = 7, view: int = 5,
+                 cell_px: int = 3, episode_length: int = 48,
+                 num_action_repeats: int = 1):
+        if grid_size < 5:
+            raise ValueError("grid_size must be >= 5 (2 cells per side "
+                             "of the wall)")
+        if view % 2 != 1:
+            raise ValueError("view must be odd (agent-centered window)")
+        self.grid_size = int(grid_size)
+        self.view = int(view)
+        self.cell_px = int(cell_px)
+        self.episode_length = int(episode_length)
+        self.num_action_repeats = max(1, int(num_action_repeats))
+        self.num_actions = 4
+        self.max_seed = 2**31 - 1
+        self.action_space = Discrete(self.num_actions)
+        side = self.view * self.cell_px
+        self.observation_spec = Observation(
+            frame=TensorSpec((side, side, self.num_channels), np.uint8,
+                             "frame"),
+            instruction=None)
+
+    # -- layout (pure function of seed, episode) ---------------------------
+
+    def _layout(self, seed, episode):
+        """(wall_col, door_row, agent_r, agent_c, key_r, key_c,
+        goal_r, goal_c) — scalars i32, solvable by construction."""
+        g = self.grid_size
+        wall = 2 + _rand_below(max(1, g - 4), seed, episode, 1)
+        door = _rand_below(g, seed, episode, 2)
+        # Near side: cols [0, wall) — agent and key, distinct cells.
+        near = wall * g
+        agent_idx = _rand_below(near, seed, episode, 3)
+        key_idx = _rand_below(near - 1, seed, episode, 4)
+        key_idx = jnp.where(key_idx >= agent_idx, key_idx + 1, key_idx)
+        agent_r, agent_c = agent_idx // wall, agent_idx % wall
+        key_r, key_c = key_idx // wall, key_idx % wall
+        # Far side: cols (wall, g).
+        far_w = g - wall - 1
+        goal_idx = _rand_below(far_w * g, seed, episode, 5)
+        goal_r = goal_idx // far_w
+        goal_c = wall + 1 + goal_idx % far_w
+        return wall, door, agent_r, agent_c, key_r, key_c, goal_r, goal_c
+
+    # -- rendering ---------------------------------------------------------
+
+    def _frame_one(self, state: DeviceGridState) -> jnp.ndarray:
+        """uint8 [view*px, view*px, 3] window centered on the agent."""
+        g, v = self.grid_size, self.view
+        wall, door, _, _, key_r, key_c, goal_r, goal_c = self._layout(
+            state.seed, state.episode)
+        half = v // 2
+        rows = state.row - half + jnp.arange(v, dtype=jnp.int32)
+        cols = state.col - half + jnp.arange(v, dtype=jnp.int32)
+        rr = rows[:, None]  # [v, 1]
+        cc = cols[None, :]  # [1, v]
+        outside = (rr < 0) | (rr >= g) | (cc < 0) | (cc >= g)
+        on_wall_col = cc == wall
+        is_door = on_wall_col & (rr == door)
+        is_wall = outside | (on_wall_col & ~is_door)
+        is_key = ((rr == key_r) & (cc == key_c)
+                  & (state.has_key == 0) & ~outside)
+        is_goal = (rr == goal_r) & (cc == goal_c) & ~outside
+
+        red = jnp.where(
+            is_wall, 255,
+            jnp.where(is_door & ~outside,
+                      jnp.where(state.door_open > 0, 64, 160), 0))
+        green = jnp.where(is_key, 255, 0)
+        # Agent marker at the window center; carrying the key brightens
+        # it so the inventory bit is observable.
+        center = jnp.arange(v) == half
+        at_center = center[:, None] & center[None, :]
+        green = jnp.where(
+            at_center, jnp.where(state.has_key > 0, 192, 128), green)
+        blue = jnp.where(is_goal, 255, 0)
+        cells = jnp.stack([red, green, blue], axis=-1).astype(jnp.uint8)
+        px = self.cell_px
+        return jnp.repeat(jnp.repeat(cells, px, axis=0), px, axis=1)
+
+    # -- transitions -------------------------------------------------------
+
+    def _reset_one(self, seed, episode) -> DeviceGridState:
+        _, _, agent_r, agent_c, _, _, _, _ = self._layout(seed, episode)
+        zero = jnp.int32(0)
+        return DeviceGridState(
+            seed=jnp.asarray(seed, jnp.int32),
+            episode=jnp.asarray(episode, jnp.int32),
+            step=zero, episode_return=jnp.float32(0.0),
+            episode_step=zero, row=agent_r, col=agent_c,
+            has_key=zero, door_open=zero)
+
+    def _substep_one(self, state: DeviceGridState, action
+                     ) -> Tuple[DeviceGridState, jnp.ndarray, jnp.ndarray]:
+        """One simulator sub-step: (new_state, reward, terminated)."""
+        g = self.grid_size
+        wall, door, _, _, key_r, key_c, goal_r, goal_c = self._layout(
+            state.seed, state.episode)
+        nr = jnp.clip(state.row + jnp.asarray(_DROW)[action], 0, g - 1)
+        nc = jnp.clip(state.col + jnp.asarray(_DCOL)[action], 0, g - 1)
+        into_door = (nc == wall) & (nr == door)
+        blocked = ((nc == wall) & ~into_door) | (
+            into_door & (state.has_key == 0))
+        nr = jnp.where(blocked, state.row, nr)
+        nc = jnp.where(blocked, state.col, nc)
+
+        picked = ((nr == key_r) & (nc == key_c)
+                  & (state.has_key == 0) & (nc < wall))
+        opened = into_door & ~blocked & (state.door_open == 0)
+        reached = (nr == goal_r) & (nc == goal_c)
+        reward = (0.5 * picked.astype(jnp.float32)
+                  + 0.5 * opened.astype(jnp.float32)
+                  + 1.0 * reached.astype(jnp.float32))
+        step = state.step + 1
+        terminated = reached | (step >= self.episode_length)
+        new_state = state._replace(
+            row=nr, col=nc, step=step,
+            has_key=jnp.maximum(state.has_key,
+                                picked.astype(jnp.int32)),
+            door_open=jnp.maximum(state.door_open,
+                                  opened.astype(jnp.int32)))
+        return new_state, reward, terminated

@@ -1,0 +1,105 @@
+"""Host twin of any device level: a gym-like ``Environment`` adapter.
+
+Device-native worlds (``device_grid_*``, ``device_minatar_*``) have no
+hand-written host implementation — their transition function IS the XLA
+program.  ``HostDeviceEnv`` drives that same function with batch 1
+under ``jit`` on whatever backend jax has, exposing the standard
+``Environment`` reset/step surface, so ``probe_env``, eval fleets, and
+the ``envs/registry.py`` prefix dispatch all work unchanged for device
+levels — and "host twin matches device env" holds by construction
+instead of by a mirrored reimplementation (the DeviceFakeEnv approach,
+which only exists because FakeEnv predates the device layer).
+
+Auto-reset note: the device protocol emits the NEXT episode's first
+observation on done; ``reset()`` here returns that already-emitted
+observation instead of advancing the env again, which composes with
+``StreamAdapter`` (envs/core.py) into exactly the device stream.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.envs.core import Environment
+
+__all__ = ["HostDeviceEnv", "make_host_device_env"]
+
+
+class HostDeviceEnv(Environment):
+    """See module docstring.  ``env`` is any DeviceEnv protocol object
+    (envs/device/protocol.py)."""
+
+    def __init__(self, env, seed: int = 0):
+        import jax
+
+        self._env = env
+        self._seed = int(seed)
+        self.action_space = env.action_space
+        self.observation_spec = env.observation_spec
+        self.native_action_repeats = env.num_action_repeats
+        # Pinned to the CPU backend: this adapter is constructed inside
+        # spawned env-worker subprocesses (the host pipeline's MultiEnv
+        # fleets and eval workers), where the default backend would
+        # initialize the TPU runtime in a CHILD while the parent holds
+        # the chip — the constraint envs/__init__.py documents.  A host
+        # twin is host-side simulation by definition; only the in-graph
+        # backend runs the env on the accelerator.
+        self._cpu = jax.local_devices(backend="cpu")[0]
+        self._step_fn = jax.jit(env.step, backend="cpu")
+        self._state = None
+        self._last_obs = None
+
+    def seed(self, seed: Optional[int]):
+        if seed is not None:
+            self._seed = int(seed)
+            self._state = None  # next reset() starts the new stream
+
+    def _obs(self, output):
+        frame = np.asarray(output.observation.frame[0])
+        from scalable_agent_tpu.envs.core import make_observation
+
+        return make_observation(frame)
+
+    def reset(self):
+        if self._state is None:
+            import jax
+
+            # initial() runs eagerly — keep its ops on the CPU backend
+            # too (the jitted step is already pinned).
+            with jax.default_device(self._cpu):
+                self._state, output = self._env.initial(
+                    np.asarray([self._seed], np.int32))
+            self._last_obs = self._obs(output)
+        # After a done step the device env has already auto-reset and
+        # emitted the new episode's first frame — hand it back.
+        return self._last_obs
+
+    def step(self, action) -> Tuple[object, float, bool, dict]:
+        if self._state is None:
+            raise RuntimeError("step() before reset()")
+        arr = np.asarray(action)
+        if arr.ndim > 0:  # composite: component 0 drives the world
+            arr = arr.reshape(-1)[0]
+        self._state, output = self._step_fn(
+            self._state, np.asarray([arr], np.int32))
+        self._last_obs = self._obs(output)
+        return (self._last_obs, np.float32(output.reward[0]),
+                bool(output.done[0]), {})
+
+    def render(self, mode: str = "rgb_array"):
+        if self._last_obs is None:
+            self.reset()
+        return self._last_obs.frame
+
+
+def make_host_device_env(full_env_name: str, **kwargs) -> HostDeviceEnv:
+    """The ``device_`` family factory envs/registry.py dispatches to.
+    Kwargs the host pipeline threads for other families (height/width/
+    with_instruction) pass through to ``make_device_env``, which
+    resolves them per level (``accepts`` filter; a truthy
+    with_instruction gets its documented clear error)."""
+    from scalable_agent_tpu.envs.device.protocol import make_device_env
+
+    num_action_repeats = int(kwargs.pop("num_action_repeats", 1))
+    return HostDeviceEnv(make_device_env(
+        full_env_name, num_action_repeats=num_action_repeats, **kwargs))

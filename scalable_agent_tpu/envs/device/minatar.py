@@ -1,0 +1,285 @@
+"""MinAtar-style Atari-lite worlds as pure XLA transition functions.
+
+Object-channel 10x10 frames, ``lax``/``jnp``-only dynamics, optional
+sticky actions — the MinAtar reduction of the Atari games (Young &
+Tian's MinAtar testbed), here reimplemented from scratch as DeviceEnv
+protocol citizens so the whole game steps inside the fused in-graph
+program.  These are *style* reimplementations, not bit-mirrors of the
+MinAtar package: the point is a real (branchy, stateful) workload on
+the device, with enough game structure to carry learning curves.
+
+Randomness is the hashed counter stream from envs/device/world.py —
+every draw a pure function of ``(seed, episode, step, tag)`` — so
+trajectories stay bit-deterministic across jit/scan boundaries.
+``sticky_prob > 0`` repeats the previous action with that probability
+(the Machado et al. stochasticity protocol), drawn from the same
+stream.
+
+- ``device_minatar_breakout``: 3 brick rows, a one-row paddle, a
+  diagonally bouncing ball; +1 per brick; losing the ball ends the
+  episode; a cleared wall respawns.  Channels: paddle, ball, trail,
+  bricks.
+- ``device_minatar_asterix``: 8 entity lanes spawn left/right movers
+  (1-in-3 gold); touching gold is +1, touching an enemy ends the
+  episode.  Channels: player, enemies, gold.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalable_agent_tpu.envs.device.world import (
+    DeviceWorld,
+    _rand_below,
+    _uniform,
+)
+from scalable_agent_tpu.envs.spaces import Discrete
+from scalable_agent_tpu.envs.spec import TensorSpec
+from scalable_agent_tpu.types import Observation
+
+__all__ = ["DeviceAsterix", "DeviceBreakout"]
+
+_GRID = 10
+
+
+class _MinAtarBase(DeviceWorld):
+    """Constructor shared by the two games (``num_actions`` and
+    ``num_channels`` are per-game class attributes)."""
+
+    def __init__(self, episode_length: int = 128,
+                 sticky_prob: float = 0.0,
+                 num_action_repeats: int = 1):
+        self.episode_length = int(episode_length)
+        self.sticky_prob = float(sticky_prob)
+        if not 0.0 <= self.sticky_prob < 1.0:
+            raise ValueError(
+                f"sticky_prob must be in [0, 1), got {sticky_prob}")
+        self.num_action_repeats = max(1, int(num_action_repeats))
+        self.max_seed = 2**31 - 1
+        self.action_space = Discrete(self.num_actions)
+        self.observation_spec = Observation(
+            frame=TensorSpec((_GRID, _GRID, self.num_channels), np.uint8,
+                             "frame"),
+            instruction=None)
+
+    def _effective_action(self, state, action):
+        """Sticky actions: repeat ``last_action`` with ``sticky_prob``
+        (compiled out entirely at the 0.0 default)."""
+        if self.sticky_prob <= 0.0:
+            return action
+        u = _uniform(state.seed, state.episode, state.step, 101)
+        return jnp.where(u < self.sticky_prob, state.last_action, action)
+
+
+class DeviceBreakoutState(NamedTuple):
+    seed: jax.Array  # i32
+    episode: jax.Array  # i32
+    step: jax.Array  # i32, simulator step within the episode
+    episode_return: jax.Array  # f32, carried accumulator
+    episode_step: jax.Array  # i32, agent steps within the episode
+    ball_r: jax.Array  # i32
+    ball_c: jax.Array  # i32
+    dir_r: jax.Array  # i32 +-1
+    dir_c: jax.Array  # i32 +-1
+    paddle_c: jax.Array  # i32
+    trail_r: jax.Array  # i32, previous ball cell
+    trail_c: jax.Array  # i32
+    bricks: jax.Array  # i32 [3, 10]
+    last_action: jax.Array  # i32, for sticky actions
+
+
+class DeviceBreakout(_MinAtarBase):
+    """Breakout on a 10x10 grid: actions {noop, left, right}."""
+
+    num_actions = 3
+    num_channels = 4
+
+    def _reset_one(self, seed, episode) -> DeviceBreakoutState:
+        zero = jnp.int32(0)
+        ball_c = _rand_below(_GRID, seed, episode, 11)
+        dir_c = 1 - 2 * _rand_below(2, seed, episode, 12)
+        return DeviceBreakoutState(
+            seed=jnp.asarray(seed, jnp.int32),
+            episode=jnp.asarray(episode, jnp.int32),
+            step=zero, episode_return=jnp.float32(0.0),
+            episode_step=zero,
+            ball_r=jnp.int32(3), ball_c=ball_c,
+            dir_r=jnp.int32(1), dir_c=dir_c,
+            paddle_c=jnp.int32(_GRID // 2),
+            trail_r=jnp.int32(3), trail_c=ball_c,
+            bricks=jnp.ones((3, _GRID), jnp.int32),
+            last_action=zero)
+
+    def _substep_one(self, state: DeviceBreakoutState, action):
+        # Paddle: left/right on the bottom row.
+        paddle = jnp.clip(
+            state.paddle_c + jnp.where(action == 1, -1, 0)
+            + jnp.where(action == 2, 1, 0), 0, _GRID - 1)
+        # Side-wall bounce first: flip dir_c when the move would leave.
+        cand_c = state.ball_c + state.dir_c
+        dir_c = jnp.where((cand_c < 0) | (cand_c >= _GRID),
+                          -state.dir_c, state.dir_c)
+        new_c = state.ball_c + dir_c
+        cand_r = state.ball_r + state.dir_r
+        dir_r = jnp.where(cand_r < 0, -state.dir_r, state.dir_r)
+        new_r = state.ball_r + dir_r
+        # Brick hit (rows 1..3): remove it, score, bounce back in r.
+        in_bricks = (new_r >= 1) & (new_r <= 3)
+        brick_row = jnp.clip(new_r - 1, 0, 2)
+        hit = in_bricks & (state.bricks[brick_row, new_c] > 0)
+        bricks = state.bricks.at[brick_row, new_c].set(
+            jnp.where(hit, 0, state.bricks[brick_row, new_c]))
+        reward = hit.astype(jnp.float32)
+        dir_r = jnp.where(hit, -dir_r, dir_r)
+        new_r = jnp.where(hit, state.ball_r, new_r)
+        # Bottom row: paddle saves (bounce), otherwise the ball is lost.
+        at_bottom = new_r >= _GRID - 1
+        saved = at_bottom & (new_c == paddle)
+        dir_r = jnp.where(saved, -dir_r, dir_r)
+        new_r = jnp.where(saved, state.ball_r, new_r)
+        lost = at_bottom & ~saved
+        # Cleared wall respawns (the next wave).
+        cleared = bricks.sum() == 0
+        bricks = jnp.where(cleared, jnp.ones_like(bricks), bricks)
+        step = state.step + 1
+        terminated = lost | (step >= self.episode_length)
+        new_state = state._replace(
+            step=step, ball_r=new_r, ball_c=new_c, dir_r=dir_r,
+            dir_c=dir_c, paddle_c=paddle, trail_r=state.ball_r,
+            trail_c=state.ball_c, bricks=bricks, last_action=action)
+        return new_state, reward, terminated
+
+    def _frame_one(self, state: DeviceBreakoutState) -> jnp.ndarray:
+        rr = jnp.arange(_GRID)[:, None]
+        cc = jnp.arange(_GRID)[None, :]
+        paddle = ((rr == _GRID - 1)
+                  & (cc == state.paddle_c)).astype(jnp.uint8) * 255
+        ball = ((rr == state.ball_r)
+                & (cc == state.ball_c)).astype(jnp.uint8) * 255
+        trail = ((rr == state.trail_r)
+                 & (cc == state.trail_c)).astype(jnp.uint8) * 255
+        bricks = jnp.zeros((_GRID, _GRID), jnp.int32)
+        bricks = bricks.at[1:4, :].set(state.bricks)
+        bricks = (bricks * 255).astype(jnp.uint8)
+        return jnp.stack([paddle, ball, trail, bricks], axis=-1)
+
+
+_SLOTS = 8  # concurrent entity lanes in asterix
+_SPAWN_EVERY = 3  # simulator steps between spawn attempts
+
+
+class DeviceAsterixState(NamedTuple):
+    seed: jax.Array  # i32
+    episode: jax.Array  # i32
+    step: jax.Array  # i32
+    episode_return: jax.Array  # f32
+    episode_step: jax.Array  # i32
+    player_r: jax.Array  # i32
+    player_c: jax.Array  # i32
+    ent_active: jax.Array  # i32 [_SLOTS]
+    ent_r: jax.Array  # i32 [_SLOTS]
+    ent_c: jax.Array  # i32 [_SLOTS]
+    ent_dir: jax.Array  # i32 [_SLOTS] +-1
+    ent_gold: jax.Array  # i32 [_SLOTS]
+    last_action: jax.Array  # i32
+
+
+class DeviceAsterix(_MinAtarBase):
+    """Asterix on a 10x10 grid: actions {noop, up, down, left, right};
+    dodge horizontally streaming enemies, collect gold."""
+
+    num_actions = 5
+    num_channels = 3
+
+    def _reset_one(self, seed, episode) -> DeviceAsterixState:
+        zero = jnp.int32(0)
+
+        def slots():
+            return jnp.zeros((_SLOTS,), jnp.int32)
+
+        return DeviceAsterixState(
+            seed=jnp.asarray(seed, jnp.int32),
+            episode=jnp.asarray(episode, jnp.int32),
+            step=zero, episode_return=jnp.float32(0.0),
+            episode_step=zero,
+            player_r=jnp.int32(_GRID // 2),
+            player_c=jnp.int32(_GRID // 2),
+            ent_active=slots(), ent_r=slots(), ent_c=slots(),
+            ent_dir=jnp.ones((_SLOTS,), jnp.int32), ent_gold=slots(),
+            last_action=zero)
+
+    def _substep_one(self, state: DeviceAsterixState, action):
+        # Player: clamped 4-way move inside the lane rows [1, 8].
+        drow = jnp.where(action == 1, -1, 0) + jnp.where(action == 2, 1, 0)
+        dcol = jnp.where(action == 3, -1, 0) + jnp.where(action == 4, 1, 0)
+        pr = jnp.clip(state.player_r + drow, 1, _GRID - 2)
+        pc = jnp.clip(state.player_c + dcol, 0, _GRID - 1)
+        # Collision check 1 of 2 (MinAtar order: player moves, check,
+        # entities move, check again): against PRE-MOVE entity cells,
+        # so a player and an entity exchanging cells in one sub-step
+        # still collide instead of phasing through each other.
+        pre_colliding = ((state.ent_active > 0) & (state.ent_r == pr)
+                         & (state.ent_c == pc))
+        # Entities stream one cell in their direction; leaving the grid
+        # frees the slot.
+        ec = state.ent_c + state.ent_dir * state.ent_active
+        off = (ec < 0) | (ec >= _GRID)
+        active = state.ent_active * (1 - off.astype(jnp.int32))
+        # Spawn attempt every _SPAWN_EVERY steps into a rotating slot.
+        # Eligibility keys on the PRE-MOVE occupancy: a slot freed this
+        # very sub-step (off-grid exit, possibly while pre-colliding
+        # with the player) must not be refilled before the collision
+        # masks below consume its old entity's gold flag — the spawn
+        # waits for the slot's next rotation instead.
+        step = state.step + 1
+        slot = (step // _SPAWN_EVERY) % _SLOTS
+        want_spawn = ((step % _SPAWN_EVERY == 0)
+                      & (state.ent_active[slot] == 0))
+        s_row = 1 + _rand_below(_GRID - 2, state.seed, state.episode,
+                                step, 21)
+        s_dir = 1 - 2 * _rand_below(2, state.seed, state.episode, step,
+                                    22)
+        s_gold = (_rand_below(3, state.seed, state.episode, step, 23)
+                  == 0).astype(jnp.int32)
+        s_col = jnp.where(s_dir > 0, 0, _GRID - 1)
+        onehot = ((jnp.arange(_SLOTS) == slot).astype(jnp.int32)
+                  * want_spawn.astype(jnp.int32))
+        active = active * (1 - onehot) + onehot
+        er = state.ent_r * (1 - onehot) + s_row * onehot
+        ec = ec * (1 - onehot) + s_col * onehot
+        edir = state.ent_dir * (1 - onehot) + s_dir * onehot
+        egold = state.ent_gold * (1 - onehot) + s_gold * onehot
+        # Collision check 2 of 2, at the post-move positions.  The
+        # spawned slot cannot pre-collide (spawn eligibility above
+        # keys on pre-move occupancy), so the pre mask composes with
+        # the post-move gold/active arrays slot-by-slot.
+        colliding = pre_colliding | (
+            (active > 0) & (er == pr) & (ec == pc))
+        gold_hit = colliding & (egold > 0)
+        enemy_hit = colliding & (egold == 0)
+        # One reward per collected gold (two converging golds pay 2).
+        reward = gold_hit.sum().astype(jnp.float32)
+        active = active * (1 - gold_hit.astype(jnp.int32))
+        terminated = enemy_hit.any() | (step >= self.episode_length)
+        new_state = state._replace(
+            step=step, player_r=pr, player_c=pc, ent_active=active,
+            ent_r=er, ent_c=ec, ent_dir=edir, ent_gold=egold,
+            last_action=action)
+        return new_state, reward, terminated
+
+    def _frame_one(self, state: DeviceAsterixState) -> jnp.ndarray:
+        rr = jnp.arange(_GRID)[:, None]
+        cc = jnp.arange(_GRID)[None, :]
+        player = ((rr == state.player_r)
+                  & (cc == state.player_c)).astype(jnp.uint8) * 255
+        ent = ((rr[:, :, None] == state.ent_r[None, None, :])
+               & (cc[:, :, None] == state.ent_c[None, None, :])
+               & (state.ent_active[None, None, :] > 0))
+        enemies = (ent & (state.ent_gold[None, None, :] == 0)).any(-1)
+        gold = (ent & (state.ent_gold[None, None, :] > 0)).any(-1)
+        return jnp.stack(
+            [player,
+             enemies.astype(jnp.uint8) * 255,
+             gold.astype(jnp.uint8) * 255], axis=-1)

@@ -1,0 +1,151 @@
+"""Shared machinery for hand-written device worlds.
+
+``DeviceWorld`` is the common chassis under the gridworld and MinAtar
+families: subclasses implement three single-env pure functions —
+``_reset_one(seed, episode) -> state``, ``_substep_one(state, action)
+-> (state, reward, terminated)``, ``_frame_one(state) -> uint8 frame``
+— and the base supplies the full DeviceEnv protocol surface: [B]
+vmapping, the action-repeat loop (masked sub-steps, summed rewards,
+early stop), auto-reset, the emitted-vs-carried episode accounting, and
+the donation-safe ``initial``.
+
+Subclass state NamedTuples must carry the five accounting fields the
+protocol's consumers read (``seed``, ``episode``, ``step``,
+``episode_return``, ``episode_step``); everything else is game state.
+
+Randomness is hashed, not carried: ``_mix``/``_rand_below``/``_uniform``
+are counter-based draws (FNV-1a + murmur avalanche in uint32 —
+wraparound multiply is defined XLA behavior), pure functions of
+whatever (seed, episode, step, tag) terms the caller mixes.  No PRNG
+key threads through the state, so trajectories are bit-deterministic
+across jit/scan boundaries and resume-exact, and ANY int32 seed is
+valid (``max_seed`` is the full int32 range).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu.envs.device.protocol import DeviceEnvSpec
+from scalable_agent_tpu.types import (
+    Observation,
+    StepOutput,
+    StepOutputInfo,
+)
+
+__all__ = ["DeviceWorld", "_mix", "_rand_below", "_uniform"]
+
+
+def _mix(*terms) -> jnp.ndarray:
+    """FNV-1a over int32 terms + a murmur-style avalanche, uint32."""
+    h = jnp.uint32(2166136261)
+    for t in terms:
+        h = (h ^ jnp.asarray(t).astype(jnp.uint32)) * jnp.uint32(16777619)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0x5BD1E995)
+    return h ^ (h >> 15)
+
+
+def _rand_below(n, *terms) -> jnp.ndarray:
+    """Hashed i32 in [0, n).  ``n`` may be traced (>= 1)."""
+    return (_mix(*terms) % jnp.asarray(n, jnp.uint32)).astype(jnp.int32)
+
+
+def _uniform(*terms) -> jnp.ndarray:
+    """Hashed f32 in [0, 1)."""
+    return (_mix(*terms) >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+class DeviceWorld:
+    """Protocol chassis; see module docstring.  Subclasses set
+    ``num_actions``, ``action_space``, ``observation_spec``,
+    ``episode_length``, ``num_action_repeats``, ``max_seed``."""
+
+    @property
+    def spec(self) -> DeviceEnvSpec:
+        return DeviceEnvSpec(
+            observation_spec=self.observation_spec,
+            action_space=self.action_space,
+            num_actions=self.num_actions)
+
+    def _effective_action(self, state, action):
+        """Hook for action stochasticity (sticky actions); identity by
+        default."""
+        return action
+
+    # -- single-env composition --------------------------------------------
+
+    def _step_one(self, state, action) -> Tuple[object, StepOutput]:
+        action = jnp.asarray(action, jnp.int32)
+        reward = jnp.float32(0.0)
+        done = jnp.bool_(False)
+        sim = state
+        for _ in range(self.num_action_repeats):
+            eff = self._effective_action(sim, action)
+            nxt, r, term = self._substep_one(sim, eff)
+            active = ~done
+            # Masked sub-step: once done, later repeats are no-ops.
+            sim = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(active, new, old), nxt, sim)
+            reward = reward + jnp.where(active, r, 0.0)
+            done = done | (active & term)
+
+        # Emitted info includes the final step; carried state resets on
+        # done (the ImpalaStream contract, envs/core.py).
+        emitted_return = state.episode_return + reward
+        emitted_step = state.episode_step + 1
+        carried = sim._replace(episode_return=emitted_return,
+                               episode_step=emitted_step)
+        # Auto-reset: the emitted observation after done is the NEXT
+        # episode's first frame (StreamAdapter contract).
+        reset = self._reset_one(state.seed, state.episode + 1)
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), reset, carried)
+        output = StepOutput(
+            reward=reward,
+            info=StepOutputInfo(
+                episode_return=emitted_return,
+                episode_step=emitted_step),
+            done=done,
+            observation=Observation(
+                frame=self._frame_one(new_state), instruction=None),
+        )
+        return new_state, output
+
+    # -- the [B] protocol surface ------------------------------------------
+
+    def initial(self, seeds) -> Tuple[object, StepOutput]:
+        seeds = jnp.asarray(seeds, jnp.int32)
+        b = seeds.shape[0]
+        state = jax.vmap(self._reset_one)(
+            seeds, jnp.zeros((b,), jnp.int32))
+        # One DISTINCT buffer per leaf (the envs/device donation
+        # lesson): vmap broadcasts equal constant leaves (step /
+        # episode_step / last_action are all zeros) from the SAME
+        # traced value, and donating a pytree with aliased leaves fails
+        # with "attempt to donate the same buffer twice".
+        state = jax.tree_util.tree_map(jnp.copy, state)
+
+        def zero_i():
+            return jnp.zeros((b,), jnp.int32)
+
+        def zero_f():
+            return jnp.zeros((b,), jnp.float32)
+
+        output = StepOutput(
+            reward=zero_f(),
+            info=StepOutputInfo(
+                episode_return=zero_f(), episode_step=zero_i()),
+            done=jnp.ones((b,), bool),
+            observation=Observation(
+                frame=jax.vmap(self._frame_one)(state),
+                instruction=None),
+        )
+        return state, output
+
+    def step(self, state, action) -> Tuple[object, StepOutput]:
+        action = jnp.asarray(action, jnp.int32)
+        if action.ndim > 1:  # composite: use component 0
+            action = action[:, 0]
+        return jax.vmap(self._step_one)(state, action)

@@ -123,7 +123,7 @@ class FakeEnv(Environment):
 
     def _cue(self, step: int) -> int:
         """The rewarded action for (seed, episode, step).  Plain modular
-        arithmetic so the device mirror (envs/device.py) reproduces it
+        arithmetic so the device mirror (envs/device/fake.py) reproduces it
         exactly in int32.  Memory mode drops the step term: one cue per
         episode."""
         mix = self._seed * 131 + self._episode * 29

@@ -51,35 +51,24 @@ def create_env(full_env_name: str, **kwargs) -> Environment:
 def _make_fake(full_env_name: str, **kwargs) -> Environment:
     from scalable_agent_tpu.envs.fake import FakeEnv
 
-    # e.g. fake_benchmark, fake_small, fake_tuple.
-    if full_env_name == "fake_benchmark":
-        kwargs.setdefault("height", 72)
-        kwargs.setdefault("width", 96)
-        kwargs.setdefault("episode_length", 1000)
-    elif full_env_name == "fake_small":
-        kwargs.setdefault("height", 16)
-        kwargs.setdefault("width", 16)
-        kwargs.setdefault("episode_length", 10)
-    elif full_env_name == "fake_bandit":
-        # Learnable contextual bandit (envs/fake.py reward_mode docs):
-        # the end-to-end learning-proof level.
-        kwargs.setdefault("height", 16)
-        kwargs.setdefault("width", 16)
-        kwargs.setdefault("episode_length", 16)
-        kwargs.setdefault("num_actions", 4)
-        kwargs.setdefault("reward_mode", "bandit")
-    elif full_env_name == "fake_memory":
-        # Cue shown only in the first frame: requires LSTM memory and a
-        # correct done-reset (envs/fake.py reward_mode docs).
-        kwargs.setdefault("height", 16)
-        kwargs.setdefault("width", 16)
-        kwargs.setdefault("episode_length", 8)
-        kwargs.setdefault("num_actions", 4)
-        kwargs.setdefault("reward_mode", "memory")
+    # Fake levels with a device twin read their parameters from the
+    # DEVICE_LEVELS registry entry (envs/device/fake.py) — ONE copy of
+    # the defaults, so probe_env's host spec and make_device_env can
+    # never skew.  (Import is lazy: env worker subprocesses import this
+    # module and must not pull the jax-importing device package until a
+    # device level is actually requested — fake levels only touch it on
+    # construction, in the parent.)
+    from scalable_agent_tpu.envs.device.protocol import DEVICE_LEVELS
+
+    entry = DEVICE_LEVELS.get(full_env_name)
+    if entry is not None:
+        for key, value in entry.defaults.items():
+            kwargs.setdefault(key, value)
     elif full_env_name == "fake_tuple":
         # Composite action space: Tuple(Discrete, Discretized) — the
         # hermetic stand-in for Doom's composite spaces
-        # (reference: envs/doom/action_space.py:13-138).
+        # (reference: envs/doom/action_space.py:13-138).  Host-only: no
+        # device twin, so its defaults live here.
         from scalable_agent_tpu.envs.spaces import (
             Discrete, Discretized, TupleSpace)
 
@@ -111,6 +100,15 @@ def _lazy_family(family: str, module: str, attr: str):
     return factory
 
 
+# Device-native levels (device_grid_*, device_minatar_* — the
+# DEVICE_LEVELS registry, envs/device/protocol.py): the host twin is
+# the HostDeviceEnv adapter driving the same XLA transition function
+# with batch 1, so probe_env/eval and the device env agree by
+# construction.  Lazy like the simulator families — the adapter jits,
+# so it imports jax.
+_make_device = _lazy_family(
+    "device_", "scalable_agent_tpu.envs.device.host",
+    "make_host_device_env")
 _make_doom = _lazy_family(
     "doom_", "scalable_agent_tpu.envs.doom.factory", "make_doom_env")
 _make_atari = _lazy_family(
@@ -122,6 +120,7 @@ _make_gym = _lazy_family(
 
 
 register_family("fake_", _make_fake, consumes_action_repeats=True)
+register_family("device_", _make_device, consumes_action_repeats=True)
 register_family("doom_", _make_doom, consumes_action_repeats=True)
 register_family("atari_", _make_atari, consumes_action_repeats=True)
 register_family("dmlab_", _make_dmlab, consumes_action_repeats=True)

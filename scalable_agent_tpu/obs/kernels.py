@@ -62,7 +62,7 @@ __all__ = [
     "write_kernels_json",
 ]
 
-_SCHEMA_VERSION = 1
+_SCHEMA_VERSION = 2  # 2: + per-row "scope" and table "scope_time_shares"
 KERNELS_JSON_NAME = "kernels.json"
 
 # The bench's per-kernel diag keys (``kernel_<name>_us`` /
@@ -140,6 +140,16 @@ _COMPUTATION_RE = re.compile(
 # ``to_apply=``.  Conditional's ``branch_computations={...}`` is a
 # list and is left to the elementwise fallback.
 _CALLS_RE = re.compile(r"(?:calls|body|to_apply)=%?([\w.\-]+)")
+# The jax.named_scope breadcrumbs inside the instruction metadata's
+# op_name — how device time attributes to pipeline stages inside one
+# fused program (runtime/ingraph.py wraps its three phases in these
+# scopes).
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_SCOPE_MARKERS = (
+    ("env_step", "env"),
+    ("actor_inference", "inference"),
+    ("learner_update", "learner"),
+)
 _LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
 _DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
 
@@ -281,8 +291,25 @@ def parse_hlo_kernel_costs(hlo_text: str) -> Dict[str, Dict[str, float]]:
                 "bytes": float(_bytes(instr["operands"])
                                + _bytes(instr["result"])),
                 "op": instr["op"],
+                "scope": _scope_of(instr["attrs"]),
             }
     return costs
+
+
+def _scope_of(attrs: str) -> Optional[str]:
+    """Pipeline-stage attribution off the instruction metadata's
+    ``op_name`` (the jax.named_scope path): "env" / "inference" /
+    "learner", or None when the instruction carries no scope marker
+    (fused kernels mixing stages keep their ROOT instruction's
+    scope)."""
+    m = _OP_NAME_RE.search(attrs)
+    if not m:
+        return None
+    op_name = m.group(1)
+    for marker, scope in _SCOPE_MARKERS:
+        if marker in op_name:
+            return scope
+    return None
 
 
 # -- trace ingestion ---------------------------------------------------------
@@ -384,6 +411,7 @@ def build_kernel_table(events: Dict[str, Dict[str, float]],
             "flops_est_per_call": cost["flops_est"],
             "bytes": cost["bytes"],
             "op": cost["op"],
+            "scope": cost.get("scope"),
         })
     scale = (flops_total / est_total
              if flops_total > 0 and est_total > 0 else 1.0)
@@ -418,6 +446,18 @@ def build_kernel_table(events: Dict[str, Dict[str, float]],
         if worst is None or row["mfu"] < worst["mfu"]:
             worst = row
     dominant = rows[0] if rows else None
+    # Stage attribution (the device_bound split obs/report.py names):
+    # matched device time by named-scope origin — env vs inference vs
+    # learner — with scope-less kernels surfaced honestly as
+    # "unattributed" rather than folded into a stage.
+    scope_time: Dict[str, float] = {}
+    for row in rows:
+        key = row["scope"] or "unattributed"
+        scope_time[key] = scope_time.get(key, 0.0) + row["time_us"]
+    scope_time_shares = {
+        key: value / matched_time
+        for key, value in sorted(scope_time.items())
+    } if matched_time else {}
     return {
         "schema_version": _SCHEMA_VERSION,
         "executions": executions,
@@ -435,6 +475,7 @@ def build_kernel_table(events: Dict[str, Dict[str, float]],
         "dominant_kernel": dominant["name"] if dominant else None,
         "dominant_time_share": (dominant["time_share"] if dominant
                                 else None),
+        "scope_time_shares": scope_time_shares,
     }
 
 

@@ -211,6 +211,7 @@ def _run_kernels(logdir: str) -> Optional[dict]:
         "dominant_time_share": table.get("dominant_time_share"),
         "worst": table.get("worst_kernel"),
         "worst_mfu": table.get("worst_kernel_mfu"),
+        "scope_time_shares": table.get("scope_time_shares") or None,
     }
 
 
@@ -414,6 +415,14 @@ def build_report(logdir: str,
 
     report["kernels"] = _run_kernels(logdir)
     report["bench_kernels"] = _bench_kernels(bench_dir)
+    # The device_bound split: once the verdict says the chip is the
+    # constraint, the next question is WHICH stage of the fused program
+    # owns the device time — env simulation, actor inference, or the
+    # learner update.  The kernel ledger's named-scope attribution
+    # (obs/kernels.py scope_time_shares, fed by runtime/ingraph.py's
+    # jax.named_scope markers) answers it from the same profile window.
+    report["device_attribution"] = (
+        (report["kernels"] or {}).get("scope_time_shares"))
     return report
 
 
@@ -520,6 +529,18 @@ def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
 
     if report["stall_verdict"]:
         lines.append(f"stall verdict: {report['stall_verdict']}")
+    attribution = report.get("device_attribution")
+    if attribution:
+        split = "  ".join(
+            f"{name} {share:.0%}"
+            for name, share in sorted(attribution.items(),
+                                      key=lambda kv: -kv[1]))
+        prefix = ("device_bound split"
+                  if report["stall_verdict"] == "device_bound"
+                  else "device-time split")
+        lines.append(
+            f"{prefix} (matched kernel time by stage, kernels.json): "
+            f"{split}")
 
     dominant = report["dominant_stage"]
     if dominant:

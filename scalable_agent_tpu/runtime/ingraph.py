@@ -1,6 +1,6 @@
 """Fused in-graph training: rollout + IMPALA update as ONE device program.
 
-With an on-device environment (envs/device.py) the whole actor side —
+With an on-device environment (envs/device/) the whole actor side —
 T agent-inference steps, T env transitions, trajectory assembly — plus
 the learner update compiles into a single jitted function.  A train step
 involves NO host↔device data movement at all (the host only dispatches),
@@ -89,6 +89,7 @@ class InGraphTrainer:
         batch: int,
         seed: int = 0,
         emit_trajectory: bool = False,
+        updates_per_dispatch: int = 1,
     ):
         self._agent = agent
         self._learner = learner
@@ -96,12 +97,33 @@ class InGraphTrainer:
         self._unroll_length = unroll_length
         self._batch = batch
         self._seed = int(seed)
+        # The multi-update megaloop: one device dispatch runs K =
+        # updates_per_dispatch fused (rollout + update) iterations as a
+        # lax.scan, so a cheap-env run is no longer bound by the
+        # per-dispatch host overhead (the Python loop + runtime launch
+        # path) — the measured fps measures the chip.  K == 1 keeps one
+        # update per dispatch THROUGH THE SAME scan body, so K is a
+        # pure batching knob: K updates are bit-exact with K dispatches
+        # of 1 over the same total update count (tests/test_device_env
+        # pins this golden property).
+        self._updates_per_dispatch = int(updates_per_dispatch)
+        if self._updates_per_dispatch < 1:
+            raise ValueError(
+                f"updates_per_dispatch must be >= 1, got "
+                f"{updates_per_dispatch}")
         # Replay tap (runtime/replay.py): when set, train_step ALSO
         # returns the unroll's device-resident Trajectory so the driver
         # can insert it into the replay slab — extra HBM output, zero
         # host traffic.  Off (the default) the fused program is
-        # unchanged.
+        # unchanged.  Incompatible with K > 1: the replay dial samples
+        # the slab BETWEEN fresh updates, which only exists between
+        # dispatches.
         self._emit_trajectory = bool(emit_trajectory)
+        if self._emit_trajectory and self._updates_per_dispatch > 1:
+            raise ValueError(
+                "emit_trajectory requires updates_per_dispatch == 1: "
+                "replayed updates interleave with fresh ones on the "
+                "host side, between dispatches")
         # Shard the rollout over the learner's data axis: one constraint
         # on the carry propagates through the scan, so env transitions
         # and agent inference compute on their batch shard's device
@@ -160,11 +182,17 @@ class InGraphTrainer:
     def _rollout(self, params, carry: RolloutCarry, rng):
         agent, env = self._agent, self._env
 
+        # The named scopes land in the compiled HLO's op_name metadata,
+        # which the kernel ledger (obs/kernels.py) reads to attribute
+        # device time env-vs-inference-vs-learner inside a
+        # device_bound verdict.
         def scan_fn(c, t):
-            out, core = actor_step(
-                agent, params, jax.random.fold_in(rng, t),
-                c.agent_output.action, c.env_output, c.core_state)
-            env_state, env_output = env.step(c.env_state, out.action)
+            with jax.named_scope("actor_inference"):
+                out, core = actor_step(
+                    agent, params, jax.random.fold_in(rng, t),
+                    c.agent_output.action, c.env_output, c.core_state)
+            with jax.named_scope("env_step"):
+                env_state, env_output = env.step(c.env_state, out.action)
             return RolloutCarry(env_state, env_output, out, core), (
                 env_output, out)
 
@@ -183,14 +211,13 @@ class InGraphTrainer:
             else jax.lax.with_sharding_constraint(x, self._batch_sharding),
             tree, is_leaf=lambda x: x is None)
 
-    def _fused(self, state, carry: TrainCarry, counter):
+    def _one_update(self, state, rollout_carry, telemetry, update_index):
+        """One fused (rollout + update) iteration — the megaloop's scan
+        body.  ``update_index`` is the GLOBAL update counter (it keys
+        the rollout rng), so K scanned iterations are the same stream
+        as K separate dispatches."""
         rng = jax.random.fold_in(
-            jax.random.key(self._seed), counter)
-        # Only the rollout state takes the batch-sharding constraint:
-        # the telemetry leaves are replicated scalars/bucket vectors
-        # with no batch axis.
-        rollout_carry = self._constrain_batch(carry.rollout)
-        telemetry = carry.telemetry
+            jax.random.key(self._seed), update_index)
         trajectory, new_rollout = self._rollout(
             state.params, rollout_carry, rng)
         # The [1:] slice drops the T+1 overlap entry (it was the
@@ -202,24 +229,66 @@ class InGraphTrainer:
             trajectory.env_outputs, is_leaf=lambda x: x is None)
         telemetry = record_episode_telemetry(
             self._env_tel_spec, telemetry, emitted)
-        new_state, telemetry, metrics = self._learner._update_impl(
-            state, trajectory, telemetry)
+        with jax.named_scope("learner_update"):
+            new_state, telemetry, metrics = self._learner._update_impl(
+                state, trajectory, telemetry)
         # Episode accounting from the on-device env stream (the host
         # backend reads MultiEnv ring buffers; here the trajectory
-        # itself carries the emitted per-done episode stats).  Consumers
-        # gate on episodes_completed > 0 before trusting the means.
+        # itself carries the emitted per-done episode stats), as SUMS so
+        # the megaloop can fold them across the scan.
         done = emitted.done
         steps = emitted.info.episode_step
         finished = jnp.logical_and(done, steps > 0)
-        count = jnp.sum(finished)
+        episode_sums = {
+            "count": jnp.sum(finished),
+            "return_sum": jnp.sum(jnp.where(
+                finished, emitted.info.episode_return, 0.0)),
+            "frames_sum": jnp.sum(jnp.where(
+                finished, steps, 0)).astype(jnp.float32),
+        }
+        return new_state, new_rollout, telemetry, metrics, \
+            episode_sums, trajectory
+
+    def _fused(self, state, carry: TrainCarry, counter):
+        # Only the rollout state takes the batch-sharding constraint:
+        # the telemetry leaves are replicated scalars/bucket vectors
+        # with no batch axis.
+        rollout_carry = self._constrain_batch(carry.rollout)
+        k = self._updates_per_dispatch
+
+        def body(loop_carry, update_index):
+            state, rollout_carry, telemetry = loop_carry
+            (state, rollout_carry, telemetry, metrics, episode_sums,
+             trajectory) = self._one_update(
+                state, rollout_carry, telemetry, update_index)
+            ys = (metrics, episode_sums)
+            if self._emit_trajectory:
+                ys = ys + (trajectory,)
+            return (state, rollout_carry, telemetry), ys
+
+        # K == 1 runs through the SAME scan body: lax.scan compiles the
+        # body as its own while-loop computation at any length, so a
+        # K-update dispatch is bit-exact with K single-update dispatches
+        # (the golden property driver resume / the K knob rely on).
+        (new_state, new_rollout, telemetry), ys = jax.lax.scan(
+            body, (state, rollout_carry, carry.telemetry),
+            counter + jnp.arange(k, dtype=jnp.int32))
+        metrics_seq, episode_seq = ys[0], ys[1]
+        # Scalar gauges (loss, lr, grad_norm, env_frames, ...) read the
+        # LAST update's value — the state the dispatch hands back;
+        # episode stats aggregate across all K unrolls.
+        metrics = jax.tree_util.tree_map(lambda x: x[-1], metrics_seq)
+        count = episode_seq["count"].sum()
         denom = jnp.maximum(count, 1).astype(jnp.float32)
         metrics["episodes_completed"] = count
-        metrics["episode_return"] = jnp.sum(jnp.where(
-            finished, emitted.info.episode_return, 0.0)) / denom
-        metrics["episode_frames"] = jnp.sum(jnp.where(
-            finished, steps, 0)).astype(jnp.float32) / denom
+        metrics["episode_return"] = episode_seq["return_sum"].sum() / denom
+        metrics["episode_frames"] = episode_seq["frames_sum"].sum() / denom
         out_carry = TrainCarry(new_rollout, telemetry)
         if self._emit_trajectory:
+            # K == 1 (enforced in __init__): drop the length-1 scan
+            # axis so the replay tap sees the plain [T+1, B] pytree.
+            trajectory = jax.tree_util.tree_map(
+                lambda x: x[0], ys[2])
             return new_state, out_carry, metrics, trajectory
         return new_state, out_carry, metrics
 
@@ -233,16 +302,36 @@ class InGraphTrainer:
 
     # -- host loop ---------------------------------------------------------
 
-    def run(self, state, carry, num_updates: int, counter_start: int = 0):
+    def run(self, state, carry, num_updates: int, counter_start: int = 0,
+            on_trajectory=None):
         """Dispatch ``num_updates`` chained fused steps WITHOUT any host
         synchronization; the caller decides when to fetch metrics (e.g.
-        ``float(np.asarray(metrics['total_loss']))``)."""
+        ``float(np.asarray(metrics['total_loss']))``).
+
+        ``on_trajectory`` is the emitted-trajectory sink for an
+        ``emit_trajectory=True`` trainer (e.g. ``replay.insert``): it
+        receives the device-resident Trajectory of every dispatch.  An
+        emitting trainer REFUSES to run without a sink — silently
+        dropping emitted trajectories here once cost replay its data
+        (the insert path and run() couldn't compose)."""
+        if self._emit_trajectory and on_trajectory is None:
+            raise ValueError(
+                "this trainer emits trajectories (emit_trajectory="
+                "True) but run() was given no on_trajectory sink; "
+                "pass one (e.g. replay.insert) or drive train_step "
+                "directly")
+        k = self._updates_per_dispatch
+        if num_updates % k:
+            raise ValueError(
+                f"num_updates {num_updates} not divisible by "
+                f"updates_per_dispatch {k}")
         metrics = None
-        for i in range(num_updates):
-            # [:3] tolerates the emit_trajectory variant (the emitted
-            # trajectory is dropped here — run() callers don't replay).
-            state, carry, metrics = self.train_step(
-                state, carry, np.int32(counter_start + i))[:3]
+        for i in range(0, num_updates, k):
+            result = self.train_step(
+                state, carry, np.int32(counter_start + i))
+            state, carry, metrics = result[:3]
+            if self._emit_trajectory:
+                on_trajectory(result[3])
         return state, carry, metrics
 
     # -- telemetry (host side, log-interval cadence) -----------------------

@@ -224,6 +224,59 @@ class TestRegressionGuard:
         assert diag["errors"] == []
 
 
+class TestDeviceEnvRegressionGuard:
+    """ISSUE 15 satellite: the device-env step-rate floor (hermetic —
+    synthesized diags against a synthesized previous artifact)."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_step_rate_drop_fails_on_tpu(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, device_env_step_rate_device_grid_small=1e7)
+        diag = {"errors": [], "platform": "tpu",
+                "device_env_step_rate_device_grid_small": 4e6}
+        bench.device_env_regression_guard(diag, bench_dir=bench_dir)
+        assert any("DEVICE ENV REGRESSION" in e
+                   for e in diag["errors"])
+
+    def test_missing_previously_published_key_fails(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, device_env_e2e_grid_small_k8_fps=3e5)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.device_env_regression_guard(diag, bench_dir=bench_dir)
+        assert any("missing" in e for e in diag["errors"])
+
+    def test_parity_passes(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path,
+            device_env_step_rate_device_grid_small=1e7,
+            device_env_e2e_grid_small_k8_fps=3e5)
+        diag = {"errors": [], "platform": "tpu",
+                "device_env_step_rate_device_grid_small": 0.9e7,
+                "device_env_e2e_grid_small_k8_fps": 2.9e5}
+        bench.device_env_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_cpu_fallback_downgrades_to_warning(self, tmp_path):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "cpu",
+                    "device_env_step_rate_device_grid_small": 1e7}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        diag = {"errors": [], "platform": "cpu",
+                "device_env_step_rate_device_grid_small": 1e6}
+        bench.device_env_regression_guard(diag,
+                                          bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert any("DEVICE ENV REGRESSION" in w
+                   for w in diag.get("warnings", []))
+
+
 class TestTransportRegressionGuard:
     """ISSUE 3 satellite: packed-vs-per-leaf and overlap invariants
     (hermetic — no bench stage runs; diag dicts are synthesized)."""

@@ -1,4 +1,4 @@
-"""On-device env (envs/device.py) + fused in-graph trainer.
+"""On-device env (envs/device/fake.py) + fused in-graph trainer.
 
 The device mirror must be transition-exact against the host stack
 ``ImpalaStream(StreamAdapter(FakeEnv))`` — frames, rewards, dones,
@@ -173,6 +173,91 @@ class TestInGraphTrainer:
         np.testing.assert_array_equal(
             np.asarray(traj1.agent_outputs.action[self.T]),
             np.asarray(traj2.agent_outputs.action[0]))
+
+
+class TestMegaloop:
+    """updates_per_dispatch=K (ISSUE 15): K fused updates per device
+    launch as one lax.scan, bit-exact with K single-update dispatches."""
+
+    T, B = 5, 4
+
+    def make(self, k, emit_trajectory=False):
+        agent = ImpalaAgent(num_actions=NUM_ACTIONS)
+        mesh = make_mesh(MeshSpec(data=1, model=1),
+                         devices=jax.devices()[:1])
+        learner = Learner(agent, LearnerHyperparams(
+            total_environment_frames=1e6), mesh,
+            frames_per_update=self.T * self.B)
+        env = DeviceFakeEnv(height=H, width=W, num_actions=NUM_ACTIONS,
+                            episode_length=7)
+        return InGraphTrainer(agent, learner, env, self.T, self.B,
+                              seed=5, updates_per_dispatch=k,
+                              emit_trajectory=emit_trajectory)
+
+    def test_k8_bit_exact_with_k1_and_episode_stats_aggregate(self):
+        """THE golden property: 1 dispatch of K=8 == 8 dispatches of
+        K=1, bitwise, in final params AND optimizer state — and the
+        megaloop's episode stats aggregate over all K unrolls
+        (episode_length 7 < the window's agent steps, so episodes
+        finish inside it) with the return mean weighted across them."""
+        t1 = self.make(1)
+        s1, c1 = t1.init(jax.random.key(0))
+        counts, ret_sums = 0.0, 0.0
+        for i in range(8):
+            s1, c1, m1 = t1.run(s1, c1, 1, counter_start=i)
+            n = float(np.asarray(m1["episodes_completed"]))
+            if n:
+                counts += n
+                ret_sums += n * float(np.asarray(m1["episode_return"]))
+        t8 = self.make(8)
+        s8, c8 = t8.init(jax.random.key(0))
+        s8, c8, m8 = t8.run(s8, c8, 8)
+        for leaf1, leaf8 in zip(
+                jax.tree_util.tree_leaves((s1.params, s1.opt_state)),
+                jax.tree_util.tree_leaves((s8.params, s8.opt_state))):
+            np.testing.assert_array_equal(np.asarray(leaf1),
+                                          np.asarray(leaf8))
+        assert float(np.asarray(m1["env_frames"])) == float(
+            np.asarray(m8["env_frames"])) == 8 * self.T * self.B
+        # Gauges read the LAST scanned update — identical streams, so
+        # identical losses too.
+        np.testing.assert_array_equal(
+            np.asarray(m1["total_loss"]), np.asarray(m8["total_loss"]))
+        # Episode aggregation: the K=8 dispatch's stats equal the sum /
+        # weighted mean over the 8 single-update dispatches.
+        assert counts > 0
+        assert float(np.asarray(m8["episodes_completed"])) == counts
+        np.testing.assert_allclose(
+            float(np.asarray(m8["episode_return"])), ret_sums / counts,
+            rtol=1e-6)
+
+    def test_run_rejects_misaligned_update_count(self):
+        trainer = self.make(4)
+        state, carry = trainer.init(jax.random.key(0))
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.run(state, carry, 6)
+
+    def test_constructor_rejects_bad_k_and_emit_with_k(self):
+        with pytest.raises(ValueError, match="updates_per_dispatch"):
+            self.make(0)
+        with pytest.raises(ValueError, match="emit_trajectory"):
+            self.make(2, emit_trajectory=True)
+
+    def test_run_refuses_to_drop_emitted_trajectories(self):
+        """Satellite fix: an emit_trajectory trainer's run() used to
+        silently discard every emitted trajectory; now it demands a
+        sink — and feeds it."""
+        trainer = self.make(1, emit_trajectory=True)
+        state, carry = trainer.init(jax.random.key(0))
+        with pytest.raises(ValueError, match="on_trajectory"):
+            trainer.run(state, carry, 2)
+        collected = []
+        state, carry, metrics = trainer.run(
+            state, carry, 3, on_trajectory=collected.append)
+        assert len(collected) == 3
+        frame = collected[0].env_outputs.observation.frame
+        assert frame.shape[:2] == (self.T + 1, self.B)
+        assert np.isfinite(float(np.asarray(metrics["total_loss"])))
 
 
 class TestInGraphDataParallel:

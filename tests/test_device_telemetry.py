@@ -323,40 +323,25 @@ class TestLearnerTelemetry:
         protocol can bypass the Python spies for zero-copy reads; the
         spied paths are exactly the idioms instrumented runtime code
         could accidentally introduce — float()/np.asarray()/item().)"""
-        import jaxlib.xla_extension as xe
+        from scalable_agent_tpu.envs.device.conformance import (
+            materialization_spy)
 
         learner, traj = learner_setup["learner"], learner_setup["traj"]
         state = learner_setup["state"]
         # Warm the compile (constants may transfer during lowering).
         state, _ = learner.update(state, traj)
 
-        calls = []
-        cls = type(jnp.zeros(()))
-        assert cls is xe.ArrayImpl
-        orig_value = cls.__dict__["_value"]
-        orig_array = cls.__array__
-
-        def spy_value(self):
-            calls.append("_value")
-            return orig_value.fget(self)
-
-        def spy_array(self, *args, **kwargs):
-            calls.append("__array__")
-            return orig_array(self, *args, **kwargs)
-
-        monkeypatch.setattr(cls, "_value", property(spy_value))
-        monkeypatch.setattr(cls, "__array__", spy_array)
-
-        with jax.transfer_guard("disallow"):
-            for _ in range(4):
-                state, metrics = learner.update(state, traj)
-        assert calls == [], (
-            f"telemetry-bearing updates materialized device values on "
-            f"the host: {calls}")
-        # The explicit fetch IS a sync — and the only one.
-        learner_setup["state"] = state
-        fetched = learner.fetch_device_telemetry()
-        assert calls, "fetch should materialize on the host"
+        with materialization_spy() as calls:
+            with jax.transfer_guard("disallow"):
+                for _ in range(4):
+                    state, metrics = learner.update(state, traj)
+            assert calls == [], (
+                f"telemetry-bearing updates materialized device values "
+                f"on the host: {calls}")
+            # The explicit fetch IS a sync — and the only one.
+            learner_setup["state"] = state
+            fetched = learner.fetch_device_telemetry()
+            assert calls, "fetch should materialize on the host"
         assert learner.devtel_spec.value(fetched, "updates") >= 4
 
     def test_disabled_telemetry_is_inert(self):

@@ -28,8 +28,12 @@ import scalable_agent_tpu
 
 PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
 
-# Directories whose modules assemble jitted programs.
-HOT_DIRS = ("runtime", "models")
+# Directories whose modules assemble jitted programs.  envs/device is
+# the on-device environment package (ISSUE 15): a debug print or
+# callback in an env step path would ride INSIDE the fused megastep's
+# scan — per-step host chatter at rollout frequency, the worst spot of
+# all.
+HOT_DIRS = ("runtime", "models", os.path.join("envs", "device"))
 
 # Callee names that are host callbacks regardless of how they are
 # reached (bare name, jax.pure_callback, jax.experimental.io_callback,
@@ -145,5 +149,49 @@ def test_hot_dirs_exist_and_are_scanned():
     names = {os.path.relpath(m, PKG_DIR) for m in modules}
     assert any(n.startswith("runtime") for n in names)
     assert any(n.startswith("models") for n in names)
-    assert os.path.join("runtime", "learner.py") in {
-        os.path.relpath(m, PKG_DIR) for m in modules}
+    assert os.path.join("runtime", "learner.py") in names
+    assert os.path.join("envs", "device", "gridworld.py") in names
+    assert os.path.join("envs", "device", "fake.py") in names
+
+
+# -- registry closure: DEVICE_LEVELS <-> conformance parametrization ---------
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _conformance_levels_literal():
+    """The CONFORMANCE_LEVELS tuple out of
+    tests/test_device_conformance.py, read via AST (no import: the lint
+    must see exactly what is WRITTEN, and stay independent of that
+    module's import-time behavior)."""
+    path = os.path.join(TESTS_DIR, "test_device_conformance.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "CONFORMANCE_LEVELS" in targets:
+                return tuple(ast.literal_eval(node.value))
+    raise AssertionError(
+        "tests/test_device_conformance.py no longer defines the "
+        "CONFORMANCE_LEVELS literal the registry-closure lint reads")
+
+
+def test_every_device_level_has_a_conformance_parametrization():
+    """Registry closure (ISSUE 15 satellite): a level registered in
+    DEVICE_LEVELS without a conformance parametrization would ship an
+    unchecked world — and a stale parametrization for a deleted level
+    would green-light nothing.  Both directions fail."""
+    from scalable_agent_tpu.envs.device.protocol import DEVICE_LEVELS
+
+    declared = set(_conformance_levels_literal())
+    registered = set(DEVICE_LEVELS)
+    missing = registered - declared
+    stale = declared - registered
+    assert not missing, (
+        f"device levels registered without a conformance "
+        f"parametrization — add them to CONFORMANCE_LEVELS in "
+        f"tests/test_device_conformance.py: {sorted(missing)}")
+    assert not stale, (
+        f"stale CONFORMANCE_LEVELS entries (level no longer "
+        f"registered — delete them): {sorted(stale)}")

@@ -72,6 +72,44 @@ ENTRY %main (x: f32[1024]) -> f32[1024] {
         # internal temporaries.
         assert costs["my_fusion"]["bytes"] == 4 * 2 * 1024
 
+    def test_scope_attribution_from_op_name_metadata(self):
+        """ISSUE 15: jax.named_scope markers (runtime/ingraph.py wraps
+        env_step / actor_inference / learner_update) surface through
+        the HLO op_name metadata as a per-instruction ``scope`` and an
+        aggregate ``scope_time_shares`` — the env-vs-learner split the
+        report names inside a device_bound verdict."""
+        hlo = """
+ENTRY %main (a: f32[128,64], b: f32[64,32]) -> f32[128,32] {
+  %a = f32[128,64]{1,0} parameter(0)
+  %b = f32[64,32]{1,0} parameter(1)
+  %env.1 = f32[128,64]{1,0} tanh(f32[128,64]{1,0} %a), metadata={op_name="jit(_fused)/while/body/env_step/tanh"}
+  %infer.1 = f32[128,64]{1,0} negate(f32[128,64]{1,0} %env.1), metadata={op_name="jit(_fused)/while/body/actor_inference/neg"}
+  %upd.1 = f32[64,32]{1,0} exponential(f32[64,32]{1,0} %b), metadata={op_name="jit(_fused)/learner_update/exp"}
+  ROOT %dot.1 = f32[128,32]{1,0} dot(f32[128,64]{1,0} %infer.1, f32[64,32]{1,0} %upd.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+        costs = kernels_lib.parse_hlo_kernel_costs(hlo)
+        assert costs["env.1"]["scope"] == "env"
+        assert costs["infer.1"]["scope"] == "inference"
+        assert costs["upd.1"]["scope"] == "learner"
+        assert costs["dot.1"]["scope"] is None
+
+        events = {
+            "env.1": {"time_us": 30.0, "calls": 1.0},
+            "infer.1": {"time_us": 20.0, "calls": 1.0},
+            "upd.1": {"time_us": 40.0, "calls": 1.0},
+            "dot.1": {"time_us": 10.0, "calls": 1.0},
+        }
+        table = kernels_lib.build_kernel_table(events, costs,
+                                               peak_flops=1e12)
+        shares = table["scope_time_shares"]
+        assert shares["env"] == pytest.approx(0.30)
+        assert shares["inference"] == pytest.approx(0.20)
+        assert shares["learner"] == pytest.approx(0.40)
+        assert shares["unattributed"] == pytest.approx(0.10)
+        by_name = {row["name"]: row for row in table["kernels"]}
+        assert by_name["env.1"]["scope"] == "env"
+
     def test_real_compiled_module_parses_and_names_ops(self):
         compiled, _ = _compiled_conv_dot()
         costs = kernels_lib.parse_hlo_kernel_costs(compiled.as_text())

@@ -195,7 +195,8 @@ class TestDeviceReplayBuffer:
         the window: it is host-local CPU-backend work by construction
         (its own int() materializes a CPU scalar, not a device fetch),
         and ``test_device_slot_draw_matches_host_mirror`` covers it."""
-        import jaxlib.xla_extension as xe
+        from scalable_agent_tpu.envs.device.conformance import (
+            materialization_spy)
 
         buf = DeviceReplayBuffer(4, seed=3)
         warm = device_tree(1.0)
@@ -206,26 +207,10 @@ class TestDeviceReplayBuffer:
 
         monkeypatch.setattr(DeviceReplayBuffer, "_mirror_slot",
                             lambda self, counter, filled: None)
-        calls = []
-        cls = type(jnp.zeros(()))
-        assert cls is xe.ArrayImpl
-        orig_value = cls.__dict__["_value"]
-        orig_array = cls.__array__
-
-        def spy_value(self):
-            calls.append("_value")
-            return orig_value.fget(self)
-
-        def spy_array(self, *args, **kwargs):
-            calls.append("__array__")
-            return orig_array(self, *args, **kwargs)
-
-        monkeypatch.setattr(cls, "_value", property(spy_value))
-        monkeypatch.setattr(cls, "__array__", spy_array)
-
-        with jax.transfer_guard("disallow"):
-            buf.insert(fresh)
-            out = buf.sample()
+        with materialization_spy() as calls:
+            with jax.transfer_guard("disallow"):
+                buf.insert(fresh)
+                out = buf.sample()
         assert calls == [], (
             f"replay insert/sample materialized device values on the "
             f"host: {calls}")
