@@ -1457,6 +1457,102 @@ def bench_devtel(diag):
             / 1e6 / sec_per_update, 6)
 
 
+def bench_health(diag):
+    """Run-health plane overhead (ISSUE 16 acceptance: <0.5% of the
+    update stage).  The plane is pure host work at the log-interval
+    TIME cadence — nothing rides the update itself — so the budget
+    check amortizes the per-interval cost over
+    ``HEALTH_LOG_INTERVAL_S`` exactly like the devtel fetch/publish
+    pair above.  Unit costs:
+
+    - ``health_snapshot_us`` — the ``registry.snapshot()`` the step
+      consumes, on a representative instrument population (the
+      driver's ~30 series including an expanded histogram).
+    - ``health_detector_step_us`` — one ``HealthMonitor.step()`` of
+      the full stock detector set over that snapshot, steady state
+      (no trips; a trip's pin+dump+append is a once-per-anomaly cost
+      bounded by cooldown, not a cadence cost).
+    - ``health_read_anomalies_us`` — the event-sourced
+      ``read_anomalies`` parse the watch console / ``/anomalies``
+      endpoint pays per poll, on a 64-record file.
+
+    ``health_frac_on_update`` = (snapshot + step) amortized at the
+    time cadence."""
+    import tempfile
+
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.obs.health import (
+        HealthMonitor, default_detectors, read_anomalies)
+
+    reg = MetricsRegistry()
+    # Representative driver-shaped population: counters + gauges +
+    # one expanded histogram (the dominant snapshot cost).
+    for i in range(12):
+        reg.counter(f"bench/c{i}", "bench").inc(i)
+    for i in range(12):
+        reg.gauge(f"bench/g{i}", "bench").set(float(i))
+    hist = reg.histogram("ledger/staleness_s", "bench")
+    for i in range(512):
+        hist.observe(0.001 * i)
+    reg.gauge("learner/fps", "bench").set(50_000.0)
+    reg.gauge("actor/fps", "bench").set(60_000.0)
+    reg.gauge("fleet/peers_alive", "bench").set(1.0)
+    reg.counter("learner/nonfinite_skips_total", "bench")
+    for seg in ("unroll", "device", "transport"):
+        reg.gauge(f"ledger/rho/{seg}", "bench").set(0.4)
+
+    class _NullRecorder:
+        # The trip path is NOT on the cadence being measured; a stub
+        # recorder keeps the 64-trip file writer below from dumping
+        # the process-global flight recorder 64 times.
+        reason_pin = None
+        last_dump_reason = None
+
+        def record(self, *args, **kwargs):
+            pass
+
+        def dump_all(self, reason=None):
+            self.last_dump_reason = reason
+
+    monitor = HealthMonitor(default_detectors(), registry=reg,
+                            recorder=_NullRecorder())
+    host_metrics = {"total_loss": 1.5, "grad_norm": 3.0}
+
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        snapshot = reg.snapshot()
+    diag["health_snapshot_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    merged = {**snapshot, **host_metrics}
+    monitor.step(merged, update=0)  # warm the rate references
+    t0 = time.perf_counter()
+    for i in range(n):
+        monitor.step(merged, update=i)
+    diag["health_detector_step_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        writer = HealthMonitor(
+            default_detectors(warmup=1), logdir=tmp,
+            registry=MetricsRegistry(), cooldown_s=0.0, max_windows=0,
+            recorder=_NullRecorder())
+        for i in range(64):
+            writer.step({"learner/fps": 1000.0 if i % 2 else 10.0},
+                        update=i)
+        read_anomalies(tmp)  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            read_anomalies(tmp)
+        diag["health_read_anomalies_us"] = round(
+            (time.perf_counter() - t0) / n * 1e6, 3)
+
+    diag["health_frac_on_update"] = round(
+        (diag["health_snapshot_us"] + diag["health_detector_step_us"])
+        / 1e6 / HEALTH_LOG_INTERVAL_S, 6)
+
+
 def bench_transport(diag, budget_s=150.0):
     """Trajectory-transport stage (ISSUE 3): packed single-copy H2D vs
     the per-leaf ``device_put`` storm at the production trajectory
@@ -2474,6 +2570,53 @@ def devtel_regression_guard(diag, bench_dir=None):
                 f"(previous round: {prev[key]}, {ref_name})")
 
 
+# The run-health plane is pure host work at the log-interval time
+# cadence (nothing rides the update), so its envelope is the tightest
+# of the obs layers: half the fleet/elastic budget.
+HEALTH_BUDGET_FRAC = 0.005
+
+# Same time cadence as devtel: the health step runs once per log
+# interval (Config.log_interval_s, default 10 s).
+HEALTH_LOG_INTERVAL_S = 10.0
+
+# The health keys bench_health publishes (obs-guard-style missing-key
+# protection).
+HEALTH_GUARD_KEYS = (
+    "health_frac_on_update",
+    "health_detector_step_us",
+    "health_snapshot_us",
+    "health_read_anomalies_us",
+)
+
+
+def health_regression_guard(diag, bench_dir=None):
+    """ISSUE 16 acceptance: fail the bench when the run-health plane
+    (registry snapshot + detector step, amortized at the
+    ``HEALTH_LOG_INTERVAL_S`` time cadence) exceeds 0.5% of the update
+    stage — binding on TPU, advisory on the CPU fallback (the devtel
+    guard discipline).  Obs-guard-style: a health key the previous
+    round's artifact published that this round didn't is always an
+    error."""
+    frac = diag.get("health_frac_on_update")
+    if frac is not None and frac > HEALTH_BUDGET_FRAC:
+        msg = (
+            f"HEALTH: run-health plane {frac:.3%} of the update stage "
+            f"exceeds the {HEALTH_BUDGET_FRAC:.1%} budget (snapshot "
+            f"{diag.get('health_snapshot_us')}us, detector step "
+            f"{diag.get('health_detector_step_us')}us)")
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, host "
+                   "scheduling dominates the measured unit costs")
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in HEALTH_GUARD_KEYS:
+        if prev.get(key) and diag.get(key) is None:
+            diag["errors"].append(
+                f"HEALTH REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
+
+
 # Per-kernel tolerances for the kernel guard: a named kernel running
 # at over 2x its previous time, or under half its previous MFU, is a
 # code regression, not window weather (on-chip kernel timings swing
@@ -2857,6 +3000,9 @@ SUITE_REGISTRY = (
     SuiteSpec("bench_devtel",
               lambda result, diag, ctx: bench_devtel(diag), 420,
               "device-telemetry accumulate/fetch/publish unit costs"),
+    SuiteSpec("bench_health",
+              lambda result, diag, ctx: bench_health(diag), 300,
+              "run-health detector step/snapshot/read unit costs"),
     SuiteSpec("bench_transport",
               lambda result, diag, ctx: bench_transport(
                   diag, budget_s=_suite_budget(diag, 150.0, 30.0)), 900,
@@ -2955,6 +3101,10 @@ GUARD_REGISTRY = (
               lambda result, diag, bench_dir: devtel_regression_guard(
                   diag, bench_dir), "tpu_binding",
               "device telemetry < 1% of the update stage"),
+    GuardSpec("health_regression_guard",
+              lambda result, diag, bench_dir: health_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "run-health plane < 0.5% of the update stage"),
     GuardSpec("device_env_regression_guard",
               lambda result, diag, bench_dir: device_env_regression_guard(
                   diag, bench_dir), "tpu_binding",

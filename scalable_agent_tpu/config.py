@@ -223,6 +223,36 @@ class Config:
     # <logdir>/metrics.prom off disk.  Multi-process runs offset the
     # port by the process index.
     metrics_http_port: int = 0
+    # -- run-health plane (obs/health.py, docs/observability.md) ---------
+    # Online anomaly detection at log-interval cadence: EWMA z-score
+    # (level shifts), CUSUM (slow drifts), hard thresholds (invariants)
+    # over throughput/loss/grad-norm/staleness/segment-rho/nonfinite/
+    # peers.  A trip appends <logdir>/anomalies.jsonl, pins + dumps the
+    # flight recorder, and may open a bounded auto-profile window.
+    health: bool = True
+    # Log intervals before a detector arms (the compile-dominated first
+    # intervals must not poison the baseline or trip an alarm).
+    health_warmup_intervals: int = 8
+    # EWMA smoothing for the detector baselines (mean and variance).
+    health_ewma_alpha: float = 0.35
+    # z-score a deviation needs to trip (with a material relative
+    # deviation); a relative drop/rise past health_rel_threshold trips
+    # on its own regardless of the variance estimate.
+    health_z_threshold: float = 4.0
+    health_rel_threshold: float = 0.6
+    # Per-detector re-trip cooldown AND the minimum gap between auto-
+    # profile windows: a flapping detector logs one suppressed count
+    # per swallowed trip instead of a record per interval.
+    health_cooldown_s: float = 120.0
+    # Auto-profile window budget for the whole run (0 disables windows;
+    # detection, records, and flightrec dumps stay on).
+    health_max_windows: int = 2
+    # Updates one anomaly-triggered profiling window spans.
+    health_window_updates: int = 5
+    # Prime detectors from the newest committed BENCH_r*.json so a run
+    # that STARTS slower than the last proving round trips immediately:
+    # '' = off, 'auto' = the repo's committed rounds, else a directory.
+    health_baseline_dir: str = ""
     # -- self-healing (docs/robustness.md) --------------------------------
     # Non-finite guard: a NaN/Inf loss or gradient makes the update a
     # no-op (params/opt_state held, frames still retired) and counts in

@@ -128,6 +128,10 @@ from scalable_agent_tpu.runtime import (
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
 from scalable_agent_tpu.runtime.exit_codes import NONFINITE_EXIT_CODE
+from scalable_agent_tpu.runtime.faults import (
+    get_fault_injector,
+    throughput_sag_s,
+)
 from scalable_agent_tpu.types import (
     AgentOutput,
     AgentState,
@@ -490,17 +494,27 @@ def _resolve_roofline_peak() -> Optional[float]:
 
 
 def _harvest_kernel_ledger(config: Config, lower_fn,
-                           executions: int) -> None:
-    """Join the finished ``--profile_dir`` trace window with the
-    compiled update's HLO + cost analysis into the per-kernel roofline
-    ledger: ``<logdir>/kernels.json`` plus ``kernel/*`` registry gauges
+                           executions: int,
+                           profile_dir: Optional[str] = None,
+                           out_name: Optional[str] = None
+                           ) -> Optional[dict]:
+    """Join a finished trace window with the compiled update's HLO +
+    cost analysis into the per-kernel roofline ledger:
+    ``<logdir>/<out_name>`` plus ``kernel/*`` registry gauges
     (obs/kernels.py; the worst-kernel verdict also feeds the stall
-    line).  Pays one AOT compile of the update — acceptable inside an
-    explicit profiling run, and the only sanctioned way to read the
+    line).  Defaults serve the scheduled ``--profile_dir`` window
+    (``kernels.json``); the run-health plane passes its own window's
+    trace dir and ``kernels.<anomaly_id>.json`` so both backends can
+    harvest a programmatic mid-run window through the same path.
+    Pays one AOT compile of the update — acceptable inside an explicit
+    profiling window, and the only sanctioned way to read the
     optimized HLO whose instruction names the trace events carry.
-    Never raises: the ledger is forensics, not the training path."""
+    Never raises: the ledger is forensics, not the training path.
+    Returns the harvested table (None on any failure)."""
     from scalable_agent_tpu.obs import kernels as kernels_lib
 
+    profile_dir = profile_dir or config.profile_dir
+    out_name = out_name or kernels_lib.KERNELS_JSON_NAME
     try:
         compiled = lower_fn().compile()
         cost = compiled.cost_analysis()
@@ -510,32 +524,34 @@ def _harvest_kernel_ledger(config: Config, lower_fn,
         hlo_text = compiled.as_text()
     except Exception:
         log.exception("kernel ledger: update compile/cost read failed")
-        return
+        return None
     try:
         table = kernels_lib.harvest(
-            config.profile_dir, hlo_text, flops,
+            profile_dir, hlo_text, flops,
             _resolve_roofline_peak(), config.logdir,
             registry=get_registry(), executions=executions,
             extra={"device_kind": jax.local_devices()[0].device_kind,
-                   "logdir": config.logdir})
+                   "logdir": config.logdir},
+            out_name=out_name)
     except Exception:
         log.exception("kernel ledger harvest failed")
-        return
+        return None
     if table is None:
         log.warning("kernel ledger: no trace files under %s",
-                    config.profile_dir)
-        return
+                    profile_dir)
+        return None
     log.info(
         "kernel ledger: %d kernels joined (%.0f%% of event time), "
         "dominant %s (%.0f%% of kernel time), worst %s (mfu %s) — "
-        "%s/kernels.json",
+        "%s/%s",
         len(table["kernels"]), 100 * table["matched_time_frac"],
         table.get("dominant_kernel"),
         100 * (table.get("dominant_time_share") or 0.0),
         table.get("worst_kernel"),
         (f"{table['worst_kernel_mfu']:.3f}"
          if table.get("worst_kernel_mfu") is not None else "n/a"),
-        config.logdir)
+        config.logdir, out_name)
+    return table
 
 
 def _configure_live_mfu(ledger, lower_fn, num_devices: int,
@@ -622,9 +638,10 @@ def _setup_observability(config: Config, coordinator: bool) -> _ObsHandles:
     if config.metrics_http_port:
         try:
             http = MetricsHTTPServer(registry,
-                                     config.metrics_http_port + proc)
-            log.info("serving Prometheus metrics on :%d/metrics",
-                     http.port)
+                                     config.metrics_http_port + proc,
+                                     logdir=config.logdir)
+            log.info("serving Prometheus metrics on :%d/metrics "
+                     "(+ /anomalies, /health)", http.port)
         except OSError as exc:  # a taken port must not kill training
             log.error("metrics HTTP endpoint unavailable on port %d: %s",
                       config.metrics_http_port + proc, exc)
@@ -659,6 +676,146 @@ def _teardown_observability(config: Config, handles: _ObsHandles):
         handles.prom.dump()
     if handles.uninstall_handlers is not None:
         handles.uninstall_handlers()
+
+
+class _HealthPlane:
+    """Driver-side state of the run-health plane (obs/health.py): the
+    ``HealthMonitor`` plus the single in-flight anomaly-triggered
+    profiling window, shared by BOTH backends so their wiring cannot
+    drift.  The monitor arbitrates (budget, cooldown, one window at a
+    time); this class owns the jax.profiler start/stop and the
+    ``_harvest_kernel_ledger`` call against the window's own trace dir
+    and ``kernels.<anomaly_id>.json`` name.  Inert (every method a
+    no-op) when ``--health`` is off."""
+
+    def __init__(self, config: Config, backend: str):
+        self.monitor = None
+        self.window_id: Optional[str] = None
+        self.window_dir: Optional[str] = None
+        self.window_stop_at: Optional[int] = None
+        self._config = config
+        if not config.health:
+            return
+        from scalable_agent_tpu.obs.health import (
+            HealthMonitor,
+            default_detectors,
+        )
+
+        self.monitor = HealthMonitor(
+            default_detectors(
+                backend=backend,
+                warmup=config.health_warmup_intervals,
+                alpha=config.health_ewma_alpha,
+                z_threshold=config.health_z_threshold,
+                rel_threshold=config.health_rel_threshold),
+            logdir=config.logdir,
+            registry=get_registry(),
+            cooldown_s=config.health_cooldown_s,
+            max_windows=config.health_max_windows)
+        if config.health_baseline_dir:
+            bench_dir = (None if config.health_baseline_dir == "auto"
+                         else config.health_baseline_dir)
+            try:
+                source = self.monitor.prime_from_bench(bench_dir)
+            except Exception:
+                log.exception("health baseline priming failed")
+                source = None
+            if source:
+                log.info("health detectors primed from committed "
+                         "round %s", source)
+
+    @property
+    def active(self) -> bool:
+        return self.monitor is not None
+
+    @property
+    def window_open(self) -> bool:
+        return self.window_stop_at is not None
+
+    def step(self, metrics, update: int, verdict=None, evidence=None):
+        """One detector pass at log cadence.  Never raises — health is
+        forensics, not the training path."""
+        if self.monitor is None:
+            return
+        try:
+            self.monitor.step(metrics=metrics, update=update,
+                              verdict=verdict, evidence=evidence)
+        except Exception:
+            log.exception("health detector step failed")
+
+    def maybe_open_window(self, updates: int) -> bool:
+        """Open the pending anomaly's profiling window (if any): its
+        own trace dir under the logdir, stop scheduled
+        ``health_window_updates`` updates from now."""
+        if self.monitor is None or self.window_open:
+            return False
+        anomaly_id = self.monitor.poll_window()
+        if anomaly_id is None:
+            return False
+        trace_dir = os.path.join(self._config.logdir,
+                                 f"health_profile.{anomaly_id}")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            log.exception("health profile window failed to start")
+            return False
+        get_tracer().set_annotate(True)
+        self.window_id = anomaly_id
+        self.window_dir = trace_dir
+        self.window_stop_at = (updates
+                               + self._config.health_window_updates)
+        self.monitor.note_window_open(anomaly_id, trace_dir)
+        log.info("health: auto-profile window %s open through update "
+                 "%d (%s)", anomaly_id, self.window_stop_at, trace_dir)
+        return True
+
+    def close_window(self, lower_fn, executions: Optional[int] = None):
+        """Stop the window's trace and harvest its kernel ledger into
+        ``kernels.<anomaly_id>.json``, finalizing the anomaly record
+        with the worst-kernel delta vs the run's baseline window."""
+        if self.monitor is None or not self.window_open:
+            return
+        anomaly_id, trace_dir = self.window_id, self.window_dir
+        self.window_id = self.window_dir = self.window_stop_at = None
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            log.exception("health profile window failed to stop")
+        get_tracer().set_annotate(False)
+        out_name = f"kernels.{anomaly_id}.json"
+        table = _harvest_kernel_ledger(
+            self._config, lower_fn,
+            executions=(executions if executions is not None
+                        else self._config.health_window_updates),
+            profile_dir=trace_dir, out_name=out_name)
+        self.monitor.note_window_result(
+            anomaly_id, table,
+            kernels_json=(os.path.join(self._config.logdir, out_name)
+                          if table else None))
+
+    def note_baseline(self, table: Optional[dict]):
+        """The scheduled ``--profile_dir`` window's kernel table — the
+        reference the anomaly windows' deltas are computed against."""
+        if self.monitor is not None and table:
+            self.monitor.note_baseline_kernels(table)
+
+    def finalize(self):
+        """Teardown: stop a still-open window's trace (no harvest —
+        the run is ending) and flush open anomaly records."""
+        if self.monitor is None:
+            return
+        if self.window_open:
+            self.window_id = self.window_dir = None
+            self.window_stop_at = None
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            get_tracer().set_annotate(False)
+        try:
+            self.monitor.flush()
+        except Exception:
+            log.exception("health flush failed")
 
 
 # NONFINITE_EXIT_CODE (71, re-exported above from runtime/exit_codes.py
@@ -805,6 +962,11 @@ def train(config: Config) -> Dict[str, float]:
     profiling = False
     completed = False
     metrics = {}
+    # Run-health plane (obs/health.py): detectors at log cadence plus
+    # the anomaly-triggered profiling window.  Constructed before the
+    # try so the finally's flush always sees it.
+    health = _HealthPlane(config, backend="host")
+    injector = get_fault_injector()
     try:
         level_names = training_level_names(config)
         multi_task = len(level_names) > 1
@@ -982,6 +1144,7 @@ def train(config: Config) -> Dict[str, float]:
         rollback_wanted = False
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
+                    and not health.window_open
                     and updates - start_updates
                     == config.profile_start_update):
                 jax.profiler.start_trace(config.profile_dir)
@@ -1009,6 +1172,14 @@ def train(config: Config) -> Dict[str, float]:
             ledger_tid = ledger.lookup(id(traj))
             with timing.time_avg("update"), interval.add_time("update"):
                 state, dispatched = learner.update(state, traj)
+                # Chaos: a deterministic mid-run slowdown (thermal
+                # throttle / noisy neighbor stand-in) the health plane
+                # must catch — occurrences count fresh update
+                # dispatches.  Inside the update timing block so the
+                # stall attributor reads it as a slow device.
+                if injector.active and injector.should_fire(
+                        "throughput_sag"):
+                    time.sleep(throughput_sag_s())
             if ledger_tid is not None:
                 ledger.stamp(ledger_tid, "dispatch")
             inflight.push(dispatched, ledger_id=ledger_tid)
@@ -1080,10 +1251,29 @@ def train(config: Config) -> Dict[str, float]:
                 # learner heartbeat across it like every other healthy
                 # long pause — the next loop touch re-arms.
                 watchdog.suspend("learner")
-                _harvest_kernel_ledger(
+                table = _harvest_kernel_ledger(
                     config,
                     lambda: learner.lower_update(state, kernel_example),
                     executions=config.profile_num_updates)
+                # The scheduled window doubles as the health plane's
+                # baseline: anomaly windows report their worst-kernel
+                # delta against it.
+                health.note_baseline(table)
+                del kernel_example
+            if health.window_open and updates >= health.window_stop_at:
+                # An anomaly-triggered profiling window just completed:
+                # same stop/harvest discipline as the scheduled window,
+                # but into kernels.<anomaly_id>.json and back into the
+                # anomaly record.
+                jax.block_until_ready(dispatched["total_loss"])
+                kernel_example = zero_trajectory(
+                    config, observation_spec, agent,
+                    batch=max(1,
+                              config.batch_size // jax.process_count()),
+                    t_plus_1=config.unroll_length + 1)
+                watchdog.suspend("learner")
+                health.close_window(
+                    lambda: learner.lower_update(state, kernel_example))
                 del kernel_example
 
             now = time.monotonic()
@@ -1177,6 +1367,18 @@ def train(config: Config) -> Dict[str, float]:
                     interval_summary.get("wait_batch", 0.0),
                     interval_summary.get("update", 0.0),
                     retire_s=interval_summary.get("retire", 0.0))
+                # Health detectors over the registry stream plus this
+                # interval's host metrics, with the verdict and ledger
+                # attribution captured at trip time; a fresh trip may
+                # arm a profiling window, opened here (next update
+                # onward profiles) unless the scheduled window is live.
+                if health.active:
+                    health.step(
+                        {**registry.snapshot(), **host_metrics},
+                        update=updates, verdict=category,
+                        evidence=evidence)
+                    if not profiling:
+                        health.maybe_open_window(updates)
                 if writer is not None:
                     writer.write(updates, host_metrics)
                     writer.write_registry(updates)
@@ -1292,6 +1494,11 @@ def train(config: Config) -> Dict[str, float]:
         configure_faults("")  # chaos spec must not outlive its run
         if profiling:
             jax.profiler.stop_trace()
+        # Health teardown: stop a still-open anomaly window's trace and
+        # append the final state of open anomaly records, BEFORE the
+        # obs teardown's final prom dump so health/* counters land in
+        # the last snapshot.
+        health.finalize()
         prefetch_stop.set()
         # Construction may have failed partway — clean up whatever
         # exists (None-guards), and always flush/close the obs state.
@@ -1627,6 +1834,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                                  registry=registry)
     # A resumed run must not re-count the checkpoint's lifetime skips.
     nonfinite.rebase(_host_scalar(state.nonfinite_skips))
+    # Run-health plane, same wiring as the host backend (no stall
+    # attributor here — the fused loop has no host pipeline to time,
+    # so anomaly records carry the ledger attribution only).
+    health = _HealthPlane(config, backend="ingraph")
+    injector = get_fault_injector()
     try:
         # Context-managed writer: the JSONL handle can't leak when the
         # loop (or checkpointing) raises.
@@ -1636,6 +1848,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             pending_tids: List[int] = []
             while frames < config.total_environment_frames:
                 if (config.profile_dir and not profiling
+                        and not health.window_open
                         and profile_stop_at is None
                         and updates - start_updates
                         >= config.profile_start_update):
@@ -1667,6 +1880,13 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                                                np.int32(updates)))
                 ledger.stamp(ledger_tid, "dispatch")
                 pending_tids.append(ledger_tid)
+                # Chaos: the same deterministic mid-run slowdown as the
+                # host backend (occurrences count dispatches), timed as
+                # update work so the interval's fps sag is attributable.
+                if injector.active and injector.should_fire(
+                        "throughput_sag"):
+                    with timing.time_avg("update"):
+                        time.sleep(throughput_sag_s())
                 if replay is not None:
                     # Same off-policy dial as the host backend: the
                     # fresh unroll lands in the slab, then R replayed
@@ -1734,11 +1954,27 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # them, each K updates' device time.
                     profiled_dispatches = -(-config.profile_num_updates
                                             // updates_per_dispatch)
-                    _harvest_kernel_ledger(
+                    health.note_baseline(_harvest_kernel_ledger(
                         config,
                         lambda: trainer.train_step.lower(
                             state, carry, np.int32(0)),
                         executions=(profiled_dispatches
+                                    * updates_per_dispatch)))
+                if (health.window_open
+                        and updates >= health.window_stop_at):
+                    # Anomaly-triggered window: same sync + retire +
+                    # heartbeat discipline as the scheduled stop above.
+                    jax.block_until_ready(metrics["total_loss"])
+                    for tid in pending_tids:
+                        ledger.close(tid, retired=True)
+                    pending_tids.clear()
+                    watchdog.suspend("learner")
+                    window_dispatches = -(-config.health_window_updates
+                                          // updates_per_dispatch)
+                    health.close_window(
+                        lambda: trainer.train_step.lower(
+                            state, carry, np.int32(0)),
+                        executions=(window_dispatches
                                     * updates_per_dispatch))
                 now = time.monotonic()
                 if now - last_log >= config.log_interval_s:
@@ -1767,9 +2003,21 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         continue
                     fps = (frames - frames_at_last_log) / (now - last_log)
                     host_metrics["fps"] = fps
+                    registry.gauge(
+                        "learner/fps",
+                        "env frames consumed per second").set(fps)
                     timing_summary = timing.summary()
                     host_metrics.update({f"timing/{k}": v
                                          for k, v in timing_summary.items()})
+                    # Run-health step rides the same cadence; no stall
+                    # attributor in the fused loop, so records carry
+                    # ledger attribution only (verdict=None).
+                    if health.active:
+                        health.step(
+                            {**registry.snapshot(), **host_metrics},
+                            update=updates)
+                        if not profiling:
+                            health.maybe_open_window(updates)
                     writer.write(updates, host_metrics)
                     if prom is not None:
                         prom.dump()
@@ -1821,6 +2069,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
         configure_faults("")
         if profiling:
             jax.profiler.stop_trace()
+        health.finalize()
         try:
             get_ledger().finalize()
         except Exception:

@@ -28,6 +28,12 @@ from scalable_agent_tpu.obs.device_telemetry import (
     DeviceTelemetry,
     TelemetryPublisher,
 )
+from scalable_agent_tpu.obs.health import (
+    DetectorSpec,
+    HealthMonitor,
+    default_detectors,
+    read_anomalies,
+)
 from scalable_agent_tpu.obs.flightrec import (
     FlightRecorder,
     configure_flight_recorder,
@@ -63,9 +69,11 @@ from scalable_agent_tpu.obs.watchdog import (
 __all__ = [
     "CATEGORIES",
     "Counter",
+    "DetectorSpec",
     "DeviceTelemetry",
     "FlightRecorder",
     "Gauge",
+    "HealthMonitor",
     "Histogram",
     "MetricsHTTPServer",
     "MetricsRegistry",
@@ -80,6 +88,7 @@ __all__ = [
     "configure_ledger",
     "configure_tracer",
     "configure_watchdog",
+    "default_detectors",
     "get_flight_recorder",
     "get_ledger",
     "get_registry",
@@ -87,6 +96,7 @@ __all__ = [
     "get_watchdog",
     "install_crash_handlers",
     "load_trace_events",
+    "read_anomalies",
     "render_prometheus",
     "span",
 ]

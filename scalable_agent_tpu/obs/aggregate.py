@@ -240,6 +240,13 @@ def _fleet_fold(family: str, metric: str, kind: str,
     # keeps the named verdict.
     if metric.startswith(("impala_devtel_", "impala_kernel_")):
         return "max"
+    # Run-health plane (obs/health.py): the counters (anomalies/
+    # suppressed/windows totals) are real Counters and SUM above; the
+    # remaining health series are verdict one-hots (fired/<detector>,
+    # open_anomalies) — "did ANY process see it" — MAX, so one
+    # process's trip survives the fold instead of averaging away.
+    if metric.startswith("impala_health_"):
+        return "max"
     if metric.endswith(("_sum", "_count")):
         return "sum"
     if "peers_alive" in metric:

@@ -99,21 +99,49 @@ class MetricsHTTPServer:
 
     Serves the registry's CURRENT exposition text on ``/metrics`` (and
     ``/``) so scrapers don't have to poll ``<logdir>/metrics.prom`` off
-    disk.  ``http.server.ThreadingHTTPServer`` on a daemon thread —
-    rendering happens per request, never on the training hot path.
-    ``port=0`` binds an ephemeral port (tests); read ``.port`` for the
-    bound value.
+    disk.  With a ``logdir``, two run-health routes ride the same
+    already-open port so a remote rig needs no extra listener:
+    ``/anomalies`` (the tail of ``anomalies.jsonl``, NDJSON — empty
+    200 when the run has none) and ``/health`` (the ``obs.watch
+    --once --json`` payload; 503 until the first prom snapshot lands).
+    ``http.server.ThreadingHTTPServer`` on a daemon thread — rendering
+    happens per request, never on the training hot path.  ``port=0``
+    binds an ephemeral port (tests); read ``.port`` for the bound
+    value.
     """
 
+    ANOMALIES_TAIL_LINES = 64
+
     def __init__(self, registry: MetricsRegistry, port: int,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", logdir: str = ""):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.split("?")[0] not in ("/", "/metrics"):
+                route = self.path.split("?")[0]
+                if route == "/anomalies" and outer._logdir:
+                    outer_body = outer._anomalies_body()
+                    self._send(outer_body, "application/x-ndjson")
+                    return
+                if route == "/health" and outer._logdir:
+                    try:
+                        payload = outer._health_payload()
+                    except FileNotFoundError as exc:
+                        # Detail goes in the body: the status line is
+                        # latin-1 only and the diagnosis may not be.
+                        self.send_error(503, "no metrics snapshot yet",
+                                        str(exc))
+                        return
+                    except Exception as exc:
+                        self.send_error(500, "health payload failed",
+                                        str(exc))
+                        return
+                    self._send(json.dumps(payload).encode() + b"\n",
+                               "application/json")
+                    return
+                if route not in ("/", "/metrics"):
                     self.send_error(404)
                     return
                 try:
@@ -121,10 +149,12 @@ class MetricsHTTPServer:
                 except Exception as exc:  # a dying gauge must 500, not die
                     self.send_error(500, str(exc))
                     return
+                self._send(
+                    body, "text/plain; version=0.0.4; charset=utf-8")
+
+            def _send(self, body: bytes, content_type: str):
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type",
-                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -133,6 +163,7 @@ class MetricsHTTPServer:
                 pass
 
         self._registry = registry
+        self._logdir = logdir
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
@@ -140,6 +171,27 @@ class MetricsHTTPServer:
             target=self._server.serve_forever, daemon=True,
             name="metrics-http")
         self._thread.start()
+
+    def _anomalies_body(self) -> bytes:
+        """The anomalies.jsonl tail as NDJSON; an absent file is an
+        empty (valid) stream, not an error — the run has no anomalies
+        yet."""
+        from scalable_agent_tpu.obs.health import ANOMALIES_JSONL
+
+        path = os.path.join(self._logdir, ANOMALIES_JSONL)
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            return b""
+        tail = lines[-self.ANOMALIES_TAIL_LINES:]
+        return ("\n".join(tail) + "\n").encode() if tail else b""
+
+    def _health_payload(self) -> dict:
+        # Lazy import: watch pulls report/rounds parsing, none of which
+        # belongs on the exporter's import path for plain scrapes.
+        from scalable_agent_tpu.obs.watch import build_payload
+
+        return build_payload(self._logdir)
 
     def close(self):
         self._server.shutdown()

@@ -480,13 +480,16 @@ def build_kernel_table(events: Dict[str, Dict[str, float]],
 
 
 def write_kernels_json(logdir: str, table: dict,
-                       extra: Optional[dict] = None) -> str:
-    """Atomically persist the kernel table as
-    ``<logdir>/kernels.json`` (the artifact obs/report.py reads)."""
+                       extra: Optional[dict] = None,
+                       name: str = KERNELS_JSON_NAME) -> str:
+    """Atomically persist the kernel table as ``<logdir>/<name>``
+    (default ``kernels.json``, the artifact obs/report.py reads; the
+    health plane writes anomaly windows as
+    ``kernels.<anomaly_id>.json``)."""
     payload = dict(table)
     if extra:
         payload.update(extra)
-    path = os.path.join(logdir, KERNELS_JSON_NAME)
+    path = os.path.join(logdir, name)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -574,7 +577,8 @@ def last_dominant(registry) -> Optional[Tuple[str, float]]:
 def harvest(profile_dir: str, hlo_text: str, flops_total: float,
             peak_flops: Optional[float], logdir: Optional[str],
             registry=None, executions: int = 1,
-            extra: Optional[dict] = None) -> Optional[dict]:
+            extra: Optional[dict] = None,
+            out_name: str = KERNELS_JSON_NAME) -> Optional[dict]:
     """Build + persist + publish the kernel ledger for one profile
     window.  Returns the table, or None when the window left no trace
     files (the profiler can fail silently on exotic backends) — never
@@ -602,6 +606,6 @@ def harvest(profile_dir: str, hlo_text: str, flops_total: float,
                                peak_flops=peak_flops,
                                executions=executions)
     if logdir:
-        write_kernels_json(logdir, table, extra=extra)
+        write_kernels_json(logdir, table, extra=extra, name=out_name)
     publish_kernel_metrics(table, registry=registry)
     return table

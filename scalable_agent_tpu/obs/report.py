@@ -40,6 +40,7 @@ import argparse
 import glob
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from scalable_agent_tpu.obs.aggregate import (
@@ -413,6 +414,28 @@ def build_report(logdir: str,
             devtel[key] = value
     report["devtel"] = devtel or None
 
+    # The run's incident timeline (obs/health.py anomalies.jsonl):
+    # the report narrates what the health plane caught, with the
+    # auto-profiled kernel verdict when a window completed.
+    from scalable_agent_tpu.obs.health import read_anomalies
+    anomalies = read_anomalies(logdir)
+    report["anomalies"] = [
+        {"id": a.get("id"), "detector": a.get("detector"),
+         "metric": a.get("metric"), "update": a.get("update"),
+         "observed": a.get("observed"), "baseline": a.get("baseline"),
+         "z": a.get("z"), "verdict": a.get("verdict"),
+         "dominant_segment": a.get("dominant_segment"),
+         "window": a.get("window")}
+        for a in anomalies] or None
+    report["health"] = {
+        "anomalies_total": _value(families, "health/anomalies_total"),
+        "suppressed_total": _value(families, "health/suppressed_total"),
+        "profile_windows_total": _value(
+            families, "health/profile_windows_total"),
+    } if any(_value(families, f"health/{k}") is not None
+             for k in ("anomalies_total", "suppressed_total",
+                       "profile_windows_total")) else None
+
     report["kernels"] = _run_kernels(logdir)
     report["bench_kernels"] = _bench_kernels(bench_dir)
     # The device_bound split: once the verdict says the chip is the
@@ -588,6 +611,32 @@ def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
             f"{artifact['opened']:.0f} records, "
             f"{artifact['abandoned']:.0f} abandoned at shutdown{extra}")
 
+    anomalies = report.get("anomalies")
+    if anomalies:
+        lines.append("")
+        lines.append(f"anomalies ({len(anomalies)} recorded — "
+                     f"obs/health.py, anomalies.jsonl)")
+        for a in anomalies:
+            z = a.get("z")
+            detail = (f" z {z:.1f}" if isinstance(z, (int, float))
+                      else "")
+            window = a.get("window") or {}
+            wline = window.get("status", "-")
+            if window.get("kernels_json"):
+                wline += f" → {os.path.basename(window['kernels_json'])}"
+                if window.get("worst_kernel"):
+                    wline += (f" worst {window['worst_kernel']} mfu "
+                              f"{_fmt(window.get('worst_kernel_mfu'), '.3f')}")
+                delta = window.get("worst_kernel_mfu_delta")
+                if isinstance(delta, (int, float)):
+                    wline += f" (Δ {delta:+.3f})"
+            lines.append(
+                f"  {a.get('id', '?'):<22} {a.get('metric', '?')} "
+                f"{_fmt(a.get('observed'), '.4g')} vs "
+                f"{_fmt(a.get('baseline'), '.4g')}{detail}  "
+                f"[{a.get('dominant_segment') or a.get('verdict') or '-'}]"
+                f"  window {wline}")
+
     if report["kernels"]:
         _render_kernel_section(
             lines, report["kernels"],
@@ -622,8 +671,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(render_report(args.logdir, bench_dir=args.bench_dir),
                   end="")
     except FileNotFoundError as exc:
-        print(str(exc))
-        return 1
+        # A missing or metrics-free logdir is an operator typo, not a
+        # crash: one diagnostic line on stderr, exit 2 (obs.watch
+        # shares the convention).
+        print(f"obs.report: {exc}", file=sys.stderr)
+        return 2
     return 0
 
 

@@ -626,6 +626,7 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
     kernels: Dict[str, Dict[int, dict]] = {}
     worst_kernel: Dict[int, dict] = {}
     learning_curves: Dict[int, list] = {}
+    anomalies: Dict[int, list] = {}
     scoreboard: Dict[int, Dict[str, dict]] = {}
 
     for art in parsed:
@@ -676,6 +677,16 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
         curve = metrics.get("learning_curve")
         if isinstance(curve, list) and curve:
             learning_curves[art.round] = curve
+        # Run-health incidents (obs/health.py): an artifact that
+        # carries an ``anomalies`` list (round_v1 rounds embed the
+        # run's anomalies.jsonl records) narrates its own incidents
+        # in the trajectory report.
+        for source in (metrics, art.raw):
+            if (isinstance(source, dict)
+                    and isinstance(source.get("anomalies"), list)
+                    and source["anomalies"]):
+                anomalies[art.round] = source["anomalies"]
+                break
         if metrics:
             scoreboard[art.round] = score_round(metrics)
 
@@ -713,6 +724,7 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
         "kernels": kernels,
         "worst_kernel": worst_kernel,
         "learning_curves": learning_curves,
+        "anomalies": anomalies,
         "multichip": load_multichip(bench_dir),
         "targets": [target._asdict() for target in R06_TARGETS],
         "scoreboard": scoreboard,
@@ -803,6 +815,27 @@ def render_trajectory(trajectory: dict) -> str:
                 f"{int(point[0])}:{point[1]}" for point in curve
                 if isinstance(point, list) and len(point) >= 2)
             lines.append(f"  r{round_no:02d}  {path}")
+
+    anomalies = trajectory.get("anomalies") or {}
+    if anomalies:
+        lines.append("")
+        lines.append("run-health anomalies (obs/health.py):")
+        for round_no in sorted(anomalies):
+            for record in anomalies[round_no]:
+                if not isinstance(record, dict):
+                    continue
+                window = record.get("window") or {}
+                z = record.get("z")
+                detail = (f" z {z:.1f}"
+                          if isinstance(z, (int, float)) else "")
+                lines.append(
+                    f"  r{round_no:02d}  "
+                    f"{record.get('id', '?'):<22} "
+                    f"{record.get('metric', '?')} "
+                    f"{_fmt_value(record.get('observed'))} vs "
+                    f"{_fmt_value(record.get('baseline'))}{detail}  "
+                    f"[{record.get('dominant_segment') or record.get('verdict') or '-'}]"
+                    f"  window {window.get('status', '-')}")
 
     multichip = [m for m in trajectory["multichip"] if m.get("valid")]
     if multichip:

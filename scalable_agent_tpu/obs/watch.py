@@ -1,0 +1,267 @@
+"""The live run-health console: one refreshing screen per logdir.
+
+::
+
+    python -m scalable_agent_tpu.obs.watch <logdir>
+    python -m scalable_agent_tpu.obs.watch <logdir> --once --json
+
+Tails the run's on-disk artifacts — ``metrics*.prom`` (folded across
+processes with obs/aggregate.py's rules when no fleet snapshot
+exists), ``anomalies.jsonl`` (obs/health.py), ``fleet_epochs.jsonl``
+(runtime/elastic.py) — and renders a one-screen health summary: fps vs
+the newest committed BENCH baseline, the stall verdict + dominant
+stage, staleness, MFU, open anomalies, fleet size.  ``--once --json``
+emits the same payload as one machine-readable object (the
+``/health`` HTTP endpoint serves it too).
+
+jax-free and stdlib-only by design: it runs on a laptop against
+rsync'd artifacts, or on the rig next to a live run (the driver's
+prom snapshot and anomaly log are append/replace-atomic, so tailing
+mid-run is safe).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Sequence
+
+from scalable_agent_tpu.obs.health import read_anomalies
+from scalable_agent_tpu.obs.ledger import SEGMENT_LABELS, SEGMENTS
+from scalable_agent_tpu.obs.report import _load_families, _value
+from scalable_agent_tpu.obs.stall import CATEGORIES
+
+__all__ = ["build_payload", "main", "render"]
+
+SCHEMA_VERSION = 1
+FLEET_EPOCHS_JSONL = "fleet_epochs.jsonl"
+
+
+def _baseline_fps(bench_dir: Optional[str]) -> Optional[dict]:
+    """The newest committed BENCH round's throughput readings — the
+    'how fast should this run be' reference line."""
+    from scalable_agent_tpu.obs import rounds
+
+    artifact = rounds.newest_artifact(bench_dir)
+    if artifact is None or not artifact.metrics:
+        return None
+    out = {"source": artifact.name}
+    for key in ("e2e_env_frames_per_sec", "ingraph_env_frames_per_sec",
+                "mfu", "sec_per_update"):
+        value = artifact.metrics.get(key)
+        if value is not None:
+            try:
+                out[key] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return out if len(out) > 1 else None
+
+
+def _last_fleet_event(logdir: str) -> Optional[dict]:
+    path = os.path.join(logdir, FLEET_EPOCHS_JSONL)
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail line
+    return None
+
+
+def build_payload(logdir: str,
+                  bench_dir: Optional[str] = None,
+                  tail: int = 5) -> dict:
+    """Everything the console renders, as one JSON-able object.
+    Raises ``FileNotFoundError`` on a missing or metrics-free logdir
+    (the CLI turns that into exit 2)."""
+    if not os.path.isdir(logdir):
+        raise FileNotFoundError(f"no such logdir: {logdir}")
+    families, source = _load_families(logdir)
+
+    verdict = None
+    for category in CATEGORIES:
+        if _value(families, f"stall/is_{category}") == 1.0:
+            verdict = category
+    shares = {}
+    for name, _, _ in SEGMENTS:
+        share = _value(families, f"ledger/latency_share/{name}")
+        if share is not None:
+            shares[name] = share
+    dominant = max(shares, key=shares.get) if shares else None
+
+    anomalies = read_anomalies(logdir)
+    open_anomalies = [
+        a for a in anomalies
+        if (a.get("window") or {}).get("status") in ("armed", "open")]
+
+    learner_fps = _value(families, "learner/fps")
+    baseline = _baseline_fps(bench_dir)
+    fps_vs_baseline = None
+    if baseline and learner_fps is not None:
+        reference = (baseline.get("e2e_env_frames_per_sec")
+                     or baseline.get("ingraph_env_frames_per_sec"))
+        if reference:
+            fps_vs_baseline = learner_fps / reference
+
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "logdir": logdir,
+        "source": source,
+        "generated_unix": time.time(),
+        "fps": {
+            "learner": learner_fps,
+            "actor": _value(families, "actor/fps"),
+            "env_frames_total": _value(families,
+                                       "learner/env_frames_total"),
+            "vs_baseline": fps_vs_baseline,
+        },
+        "baseline": baseline,
+        "verdict": {
+            "category": verdict,
+            "dominant_segment": dominant,
+            "dominant_share": shares.get(dominant) if dominant else None,
+        },
+        "staleness_p95_s": _value(families, "ledger/staleness_s",
+                                  quantile="0.95"),
+        "mfu": _value(families, "ledger/mfu"),
+        "nonfinite_skips": _value(families,
+                                  "learner/nonfinite_skips_total"),
+        "fleet": {
+            "peers_alive": _value(families, "fleet/peers_alive"),
+            "last_event": _last_fleet_event(logdir),
+        },
+        "health": {
+            "anomalies": len(anomalies),
+            "open": len(open_anomalies),
+            "suppressed": _value(families, "health/suppressed_total"),
+            "profile_windows": _value(families,
+                                      "health/profile_windows_total"),
+            "recent": anomalies[-tail:],
+        },
+    }
+    return payload
+
+
+def _fmt(value, spec: str = ".0f", unit: str = "") -> str:
+    if value is None:
+        return "-"
+    return format(value, spec) + unit
+
+
+def render(payload: dict) -> str:
+    """The one-screen text view of ``build_payload``'s object."""
+    lines: List[str] = []
+    stamp = time.strftime("%H:%M:%S",
+                          time.localtime(payload["generated_unix"]))
+    lines.append(f"run health — {payload['logdir']}  "
+                 f"[{payload['source']} @ {stamp}]")
+    fps = payload["fps"]
+    fps_line = (f"fps        learner {_fmt(fps['learner'])}   "
+                f"actor {_fmt(fps['actor'])}   "
+                f"frames {_fmt(fps['env_frames_total'])}")
+    baseline = payload.get("baseline")
+    if fps.get("vs_baseline") is not None and baseline:
+        fps_line += (f"   ({fps['vs_baseline']:.2f}x of "
+                     f"{baseline['source']})")
+    lines.append(fps_line)
+    verdict = payload["verdict"]
+    if verdict["category"] or verdict["dominant_segment"]:
+        where = ""
+        if verdict["dominant_segment"]:
+            label = SEGMENT_LABELS.get(verdict["dominant_segment"],
+                                       verdict["dominant_segment"])
+            share = verdict["dominant_share"]
+            where = (f" — {share:.0%} of frame latency in {label}"
+                     if share is not None else f" — {label}")
+        lines.append(f"verdict    {verdict['category'] or 'n/a'}{where}")
+    lines.append(
+        f"pipeline   staleness p95 {_fmt(payload['staleness_p95_s'], '.3f', 's')}"
+        f"   mfu {_fmt(payload['mfu'], '.3f')}"
+        f"   nonfinite skips {_fmt(payload['nonfinite_skips'])}")
+    fleet = payload["fleet"]
+    if fleet["peers_alive"] is not None or fleet["last_event"]:
+        event = fleet["last_event"] or {}
+        extra = ""
+        if event:
+            extra = (f"   epoch {event.get('epoch', '-')}"
+                     f" ({event.get('event', event.get('kind', '?'))})")
+        lines.append(
+            f"fleet      peers {_fmt(fleet['peers_alive'])}{extra}")
+    health = payload["health"]
+    lines.append(
+        f"anomalies  {health['anomalies']} total"
+        f" ({health['open']} open,"
+        f" {_fmt(health['suppressed'])} suppressed,"
+        f" {_fmt(health['profile_windows'])} profile windows)")
+    for record in health["recent"]:
+        window = record.get("window") or {}
+        status = window.get("status", "-")
+        line = (f"  {record.get('id', '?'):<22} "
+                f"{record.get('metric', '?')} "
+                f"{_fmt(record.get('observed'), '.4g')} vs "
+                f"{_fmt(record.get('baseline'), '.4g')}")
+        z = record.get("z")
+        if isinstance(z, (int, float)):
+            line += f" (z {z:.1f})"
+        line += f"  window {status}"
+        if window.get("worst_kernel"):
+            line += (f" → {window['worst_kernel']} mfu "
+                     f"{_fmt(window.get('worst_kernel_mfu'), '.3f')}")
+            delta = window.get("worst_kernel_mfu_delta")
+            if isinstance(delta, (int, float)):
+                line += f" (Δ {delta:+.3f})"
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Live one-screen run-health console over a logdir's "
+                    "prom/anomaly/fleet artifacts.  jax-free.")
+    parser.add_argument("logdir", help="run log directory")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period in seconds")
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable payload "
+                             "(implies --once)")
+    parser.add_argument("--bench_dir", default=None,
+                        help="directory holding committed BENCH_r*.json "
+                             "baselines (default: the repo root)")
+    parser.add_argument("--tail", type=int, default=5,
+                        help="recent anomaly records shown")
+    args = parser.parse_args(argv)
+
+    def frame() -> str:
+        payload = build_payload(args.logdir, bench_dir=args.bench_dir,
+                                tail=args.tail)
+        if args.json:
+            return json.dumps(payload, indent=1) + "\n"
+        return render(payload)
+
+    try:
+        if args.once or args.json:
+            sys.stdout.write(frame())
+            return 0
+        while True:
+            text = frame()
+            sys.stdout.write("\x1b[2J\x1b[H" + text)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except FileNotFoundError as exc:
+        print(f"obs.watch: {exc}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,12 @@ runtime's failure-prone seams —
   inference thread for ``SERVICE_STALL_S`` seconds (occurrences count
   formed batches) — the service's watchdog heartbeat must go stale and
   dump forensics instead of silently starving the learner.
+- ``throughput_sag`` (driver.py, both backends): sleep
+  ``THROUGHPUT_SAG_S`` seconds inside the update loop (occurrences
+  count update dispatches) — a deterministic stand-in for a mid-run
+  slowdown (thermal throttle, noisy neighbor, input stall) that the
+  run-health plane (obs/health.py) must detect, attribute, and
+  auto-profile end-to-end.
 - ``peer_exit``  (runtime/fleet.py): ``os._exit(1)`` from the fleet
   monitor cycle — sudden peer death; SURVIVORS must detect the stale
   heartbeat and exit 72.  Occurrences count monitor cycles.
@@ -57,6 +63,7 @@ Every fired fault is breadcrumbed in the flight recorder (kind
 artifacts show exactly which faults the recovery metrics answered.
 """
 
+import os
 import re
 import threading
 from typing import Dict, FrozenSet
@@ -66,12 +73,32 @@ from scalable_agent_tpu.obs import get_flight_recorder, get_registry
 __all__ = [
     "FaultInjector",
     "InjectedFault",
+    "THROUGHPUT_SAG_S",
     "configure_faults",
     "get_fault_injector",
     "parse_chaos_spec",
+    "throughput_sag_s",
 ]
 
 _ENTRY_RE = re.compile(r"([A-Za-z_][\w.]*)@(\d+(?::\d+)*)\Z")
+
+# How long the ``throughput_sag`` point sleeps in the driver's update
+# loop when it fires.  Long enough that a log interval containing the
+# sag shows a decisive fps drop even on a fast CPU test config (the
+# health detectors' rel_threshold path), short enough that a chaos run
+# stays inside tier-1 time budgets.
+THROUGHPUT_SAG_S = 0.45
+
+
+def throughput_sag_s() -> float:
+    """The sag duration, env-overridable for tests (the
+    ``SCALABLE_AGENT_SERVICE_STALL_S`` pattern from
+    runtime/service.py)."""
+    try:
+        return float(os.environ.get("SCALABLE_AGENT_THROUGHPUT_SAG_S",
+                                    THROUGHPUT_SAG_S))
+    except ValueError:
+        return THROUGHPUT_SAG_S
 
 
 class InjectedFault(RuntimeError):

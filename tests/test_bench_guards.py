@@ -916,3 +916,74 @@ class TestGuardRegistry:
         assert any("boom_guard failed" in e for e in diag["errors"])
         # The rest of the registry still ran after the crash.
         assert summary["elastic_regression_guard"]["status"] == "ok"
+
+class TestHealthRegressionGuard:
+    """ISSUE 16 satellite: the run-health plane (snapshot + detector
+    step at the log-interval time cadence) must stay under 0.5% of the
+    update stage — binding on TPU, advisory on the CPU fallback — with
+    obs-guard-style missing-key protection."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "health_snapshot_us": 150.0,
+                "health_detector_step_us": 25.0,
+                "health_read_anomalies_us": 200.0}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self, tmp_path):
+        diag = self._diag(health_frac_on_update=0.02)
+        bench.health_regression_guard(diag, bench_dir=str(tmp_path))
+        assert any("HEALTH" in e and "0.5%" in e
+                   for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self, tmp_path):
+        diag = self._diag(platform="cpu", health_frac_on_update=0.02)
+        bench.health_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert any("HEALTH" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self, tmp_path):
+        diag = self._diag(health_frac_on_update=0.0001)
+        bench.health_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.health_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, health_frac_on_update=0.0001,
+            health_snapshot_us=140.0, health_detector_step_us=20.0,
+            health_read_anomalies_us=180.0)
+        diag = {"errors": [], "platform": "tpu"}  # stage vanished
+        bench.health_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "HEALTH REGRESSION" in e and "missing" in e]
+        assert len(missing) == len(bench.HEALTH_GUARD_KEYS)
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     health_snapshot_us=140.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.health_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_bench_health_is_hermetic_and_under_budget(self):
+        """The suite itself: jax-free unit costs on a private registry
+        must come in far below the budget on any host."""
+        diag = {"errors": [], "platform": "cpu", "stage": ""}
+        bench.bench_health(diag)
+        for key in bench.HEALTH_GUARD_KEYS:
+            assert diag.get(key) is not None, key
+        assert diag["health_frac_on_update"] < bench.HEALTH_BUDGET_FRAC
