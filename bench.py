@@ -1553,6 +1553,157 @@ def bench_health(diag):
         / 1e6 / HEALTH_LOG_INTERVAL_S, 6)
 
 
+def bench_learning_dynamics(diag):
+    """Learning-dynamics plane overhead (ISSUE 17 acceptance: <1% of
+    the update stage).  Two in-graph costs paid PER UPDATE plus the
+    amortized log-cadence pair, the bench_devtel discipline:
+
+    - ``learning_stats_us`` — computing the statistics themselves at a
+      representative shape (T=20, B=32, A=16 logits; [T*B, 256] torso
+      activations; a 3-group param tree): V-trace importance
+      diagnostics (clip fractions, log-rho mean/p95, ESS), policy
+      entropy, behaviour→learner KL, value explained-variance,
+      dead-unit fraction, and the three per-layer-group norm
+      reductions.  Pipelined-scan timed so dispatch is paid once.
+    - ``learning_accumulate_us`` — folding those scalars into the
+      donated devtel pytree: the full ``learning_telemetry_spec``
+      instrument set (19 gauge sets + the 2 IMPACT histogram
+      observes + 2 IMPACT gauges).
+    - ``learning_fetch_us`` / ``learning_publish_us`` — the
+      log-interval device→host materialization of the learn namespace
+      and the host-side registry fold, amortized at
+      ``DEVTEL_LOG_INTERVAL_S`` exactly like bench_devtel (in
+      production they ride the SAME merged fetch as the base learner
+      instruments, so this double-counts the transfer — the
+      conservative direction).
+
+    ``learning_overhead_frac_on_update`` = (stats + accumulate) per
+    update + (fetch + publish) per log interval, as a fraction of the
+    headline ``sec_per_update``.  The suite also publishes the
+    measured off-policy readings themselves
+    (``learning_rho_clip_fraction`` / ``learning_ess_frac`` /
+    ``learning_entropy_frac``) so ``rounds report`` can carry the
+    learning-dynamics trajectory across rounds."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalable_agent_tpu.obs import MetricsRegistry
+    from scalable_agent_tpu.obs.device_telemetry import TelemetryPublisher
+    from scalable_agent_tpu.ops.vtrace import importance_diagnostics
+    from scalable_agent_tpu.runtime.learner import learning_telemetry_spec
+
+    t_len, batch, actions, units = 20, 32, 16, 256
+    rng = np.random.default_rng(17)
+    behaviour_logits = jnp.asarray(
+        rng.normal(size=(t_len, batch, actions)), jnp.float32)
+    # A mildly off-policy learner: shifted logits so the clip
+    # fractions / ESS readings are non-degenerate.
+    online_logits = behaviour_logits + jnp.asarray(
+        rng.normal(scale=0.3, size=(t_len, batch, actions)), jnp.float32)
+    acts = jnp.asarray(rng.integers(0, actions, size=(t_len, batch)))
+    vs = jnp.asarray(rng.normal(size=(t_len, batch)), jnp.float32)
+    baselines = vs + jnp.asarray(
+        rng.normal(scale=0.5, size=(t_len, batch)), jnp.float32)
+    conv_out = jnp.asarray(
+        rng.normal(size=(t_len * batch, units)), jnp.float32)
+    groups = tuple(
+        jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)
+        for _ in range(3))
+
+    def stats(behaviour_logits, online_logits, vs, baselines, conv_out,
+              *group_params):
+        log_b = jax.nn.log_softmax(behaviour_logits)
+        log_o = jax.nn.log_softmax(online_logits)
+        taken = jax.nn.one_hot(acts, actions, dtype=jnp.float32)
+        log_rhos = jnp.sum((log_o - log_b) * taken, axis=-1)
+        d = importance_diagnostics(log_rhos)
+        entropy = jnp.mean(-jnp.sum(jnp.exp(log_o) * log_o, axis=-1))
+        kl = jnp.mean(
+            jnp.sum(jnp.exp(log_b) * (log_b - log_o), axis=-1))
+        ev = 1.0 - (jnp.var(vs - baselines)
+                    / jnp.maximum(jnp.var(vs), jnp.float32(1e-8)))
+        dead = jnp.mean(
+            jnp.all(conv_out <= 0.0, axis=0).astype(jnp.float32))
+        out = {
+            "entropy_frac": entropy / jnp.log(jnp.float32(actions)),
+            "kl": kl, "explained_variance": ev,
+            "dead_torso_frac": dead,
+            "rho_clip_fraction": d.rho_clip_fraction,
+            "cs_clip_fraction": d.cs_clip_fraction,
+            "pg_rho_clip_fraction": d.pg_rho_clip_fraction,
+            "log_rho_mean": d.log_rho_mean,
+            "log_rho_p95": d.log_rho_p95,
+            "ess_frac": d.ess_frac,
+        }
+        for name, p in zip(("torso", "core", "heads"), group_params):
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            out[f"grad_norm_{name}"] = p_norm
+            out[f"param_norm_{name}"] = p_norm
+            out[f"update_ratio_{name}"] = p_norm / (p_norm + 1e-8)
+        return out
+
+    stat_args = (behaviour_logits, online_logits, vs, baselines,
+                 conv_out) + groups
+    _record_timed(diag, "learning_stats_us", stats, stat_args, iters=50)
+
+    spec = learning_telemetry_spec("impact")
+    tel = spec.init()
+
+    def accumulate(tel, scalars):
+        for name in scalars:
+            tel = spec.set(tel, name, scalars[name])
+        for hist, value in (("impact_ratio", scalars["ess_frac"] + 1.0),
+                            ("impact_clip_fraction",
+                             scalars["rho_clip_fraction"])):
+            tel = spec.observe(tel, hist, value,
+                               where=jnp.isfinite(value))
+        tel = spec.set(tel, "impact_log_ratio_p95",
+                       scalars["log_rho_p95"])
+        tel = spec.set(tel, "impact_ess_frac", scalars["ess_frac"])
+        return tel
+
+    scalars = jax.jit(stats)(*stat_args)
+    _record_timed(diag, "learning_accumulate_us", accumulate,
+                  (tel, scalars), iters=200)
+
+    # The measured readings themselves, for the round trajectory.
+    for key, out in (("rho_clip_fraction", "learning_rho_clip_fraction"),
+                     ("ess_frac", "learning_ess_frac"),
+                     ("entropy_frac", "learning_entropy_frac")):
+        diag[out] = round(float(np.asarray(scalars[key])), 6)
+
+    filled = jax.jit(accumulate)(tel, scalars)
+    spec.fetch(filled)  # warm
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fetched = spec.fetch(filled)
+    diag["learning_fetch_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    publisher = TelemetryPublisher(spec, registry=MetricsRegistry())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        publisher.publish(fetched)
+    diag["learning_publish_us"] = round(
+        (time.perf_counter() - t0) / n * 1e6, 3)
+
+    sec_per_update = diag.get("sec_per_update")
+    if sec_per_update:
+        per_update_us = (diag["learning_stats_us"]
+                         + diag["learning_accumulate_us"])
+        log_cadence_us = (diag["learning_fetch_us"]
+                          + diag["learning_publish_us"])
+        diag["learning_stats_overhead_frac"] = round(
+            diag["learning_stats_us"] / 1e6 / sec_per_update, 6)
+        diag["learning_overhead_frac_on_update"] = round(
+            per_update_us / 1e6 / sec_per_update
+            + log_cadence_us / 1e6 / DEVTEL_LOG_INTERVAL_S, 6)
+        diag["learning_worst_case_frac_on_update"] = round(
+            (per_update_us + log_cadence_us) / 1e6 / sec_per_update, 6)
+
+
 def bench_transport(diag, budget_s=150.0):
     """Trajectory-transport stage (ISSUE 3): packed single-copy H2D vs
     the per-leaf ``device_put`` storm at the production trajectory
@@ -2617,6 +2768,56 @@ def health_regression_guard(diag, bench_dir=None):
                 f"(previous round: {prev[key]}, {ref_name})")
 
 
+# The learning-dynamics plane rides INSIDE the jitted update like the
+# base devtel instruments (stats + accumulate per update, fetch/publish
+# at the log cadence), so it shares their 1% envelope.
+LEARNING_BUDGET_FRAC = 0.01
+
+# The keys bench_learning_dynamics publishes (obs-guard-style
+# missing-key protection: a key the previous round had must not
+# silently vanish).
+LEARNING_GUARD_KEYS = (
+    "learning_overhead_frac_on_update",
+    "learning_stats_overhead_frac",
+    "learning_worst_case_frac_on_update",
+    "learning_stats_us",
+    "learning_accumulate_us",
+    "learning_fetch_us",
+    "learning_publish_us",
+)
+
+
+def learning_regression_guard(diag, bench_dir=None):
+    """ISSUE 17 acceptance: fail the bench when the learning-dynamics
+    plane (in-graph stats + devtel accumulate per update, fetch/publish
+    amortized at the ``DEVTEL_LOG_INTERVAL_S`` time cadence) exceeds 1%
+    of the update stage — binding on TPU, advisory on the CPU fallback
+    where the tiny sec_per_update makes the ratio jitter-bound (the
+    devtel guard discipline).  Obs-guard-style: a learning key the
+    previous round's artifact published that this round didn't is
+    always an error."""
+    frac = diag.get("learning_overhead_frac_on_update")
+    if frac is not None and frac > LEARNING_BUDGET_FRAC:
+        msg = (
+            f"LEARNING: learning-dynamics overhead {frac:.3%} of the "
+            f"update stage exceeds the {LEARNING_BUDGET_FRAC:.0%} "
+            f"budget (stats {diag.get('learning_stats_us')}us, "
+            f"accumulate {diag.get('learning_accumulate_us')}us, fetch "
+            f"{diag.get('learning_fetch_us')}us, publish "
+            f"{diag.get('learning_publish_us')}us)")
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, the tiny "
+                   "sec_per_update makes the ratio jitter-bound")
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in LEARNING_GUARD_KEYS:
+        if prev.get(key) and diag.get(key) is None:
+            diag["errors"].append(
+                f"LEARNING REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
+
+
 # Per-kernel tolerances for the kernel guard: a named kernel running
 # at over 2x its previous time, or under half its previous MFU, is a
 # code regression, not window weather (on-chip kernel timings swing
@@ -3003,6 +3204,11 @@ SUITE_REGISTRY = (
     SuiteSpec("bench_health",
               lambda result, diag, ctx: bench_health(diag), 300,
               "run-health detector step/snapshot/read unit costs"),
+    SuiteSpec("bench_learning_dynamics",
+              lambda result, diag, ctx: bench_learning_dynamics(diag),
+              420,
+              "learning-dynamics plane stats/accumulate/fetch/publish "
+              "unit costs + off-policy readings"),
     SuiteSpec("bench_transport",
               lambda result, diag, ctx: bench_transport(
                   diag, budget_s=_suite_budget(diag, 150.0, 30.0)), 900,
@@ -3105,6 +3311,10 @@ GUARD_REGISTRY = (
               lambda result, diag, bench_dir: health_regression_guard(
                   diag, bench_dir), "tpu_binding",
               "run-health plane < 0.5% of the update stage"),
+    GuardSpec("learning_regression_guard",
+              lambda result, diag, bench_dir: learning_regression_guard(
+                  diag, bench_dir), "tpu_binding",
+              "learning-dynamics plane < 1% of the update stage"),
     GuardSpec("device_env_regression_guard",
               lambda result, diag, bench_dir: device_env_regression_guard(
                   diag, bench_dir), "tpu_binding",

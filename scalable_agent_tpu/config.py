@@ -223,6 +223,13 @@ class Config:
     # <logdir>/metrics.prom off disk.  Multi-process runs offset the
     # port by the process index.
     metrics_http_port: int = 0
+    # Learning-dynamics plane (docs/observability.md): V-trace/IMPACT
+    # clip + ESS diagnostics, policy entropy/KL, value explained-
+    # variance, and per-layer-group optimizer telemetry accumulated
+    # in-graph (devtel/learn/*, zero added host syncs), read by the
+    # health detectors, obs.watch, obs.report, and `python -m
+    # scalable_agent_tpu.obs.diagnose <logdir>`.
+    learn_telemetry: bool = True
     # -- run-health plane (obs/health.py, docs/observability.md) ---------
     # Online anomaly detection at log-interval cadence: EWMA z-score
     # (level shifts), CUSUM (slow drifts), hard thresholds (invariants)

@@ -1638,6 +1638,7 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
     return Learner(agent, hp, mesh, config.frames_per_update(),
                    scan_impl=config.scan_impl,
                    transport=transport,
+                   learn_telemetry=config.learn_telemetry,
                    loss=config.loss,
                    target_update_interval=config.target_update_interval,
                    impact_clip_epsilon=config.impact_clip_epsilon)
@@ -2019,6 +2020,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         if not profiling:
                             health.maybe_open_window(updates)
                     writer.write(updates, host_metrics)
+                    # Registry snapshot rows (obs/ prefix): the per-
+                    # interval devtel/learn/* series obs.report's
+                    # staleness↔clipping join and obs.diagnose read —
+                    # the host backend has always written these.
+                    writer.write_registry(updates)
                     if prom is not None:
                         prom.dump()
                     log.info(

@@ -238,6 +238,18 @@ def _fleet_fold(family: str, metric: str, kind: str,
     # kernel MFU folds to the busiest process's reading and the worst-
     # kernel label rides the per-kernel series NAME, so the max fold
     # keeps the named verdict.
+    # Learning-dynamics plane (devtel/learn/*, runtime/learner.py
+    # learning_telemetry_spec) BEFORE the generic devtel max: the
+    # health-of-learning gauges where LOW is bad (normalized entropy,
+    # importance-weight ESS, value explained-variance) fold to the
+    # most-pessimistic process — MIN — so one collapsing process can't
+    # hide behind its healthy peers.  Every other learn series (clip
+    # fractions, KL, log-rho drift, dead units, grad/update norms —
+    # high is bad) takes the generic devtel MAX below.
+    if metric.startswith("impala_devtel_learn_") and any(
+            token in metric for token in
+            ("entropy_frac", "ess_frac", "explained_variance")):
+        return "min"
     if metric.startswith(("impala_devtel_", "impala_kernel_")):
         return "max"
     # Run-health plane (obs/health.py): the counters (anomalies/

@@ -58,6 +58,7 @@ import time
 from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 from scalable_agent_tpu.obs.flightrec import get_flight_recorder
+from scalable_agent_tpu.obs.learning import MATERIAL_LOG_RHO
 from scalable_agent_tpu.obs.ledger import get_ledger
 from scalable_agent_tpu.obs.registry import MetricsRegistry, get_registry
 
@@ -225,6 +226,17 @@ def default_detectors(backend: str = "host",
                   if f"ledger/rho/{seg}" in snapshot]
         return max(values) if values else None
 
+    def _material_clip_fraction(
+            snapshot: Mapping[str, float]) -> Optional[float]:
+        clip = snapshot.get("devtel/learn/rho_clip_fraction")
+        if clip is None:
+            return None
+        p95 = snapshot.get("devtel/learn/log_rho_p95")
+        # A missing p95 cannot prove immateriality, so it does not gate.
+        if p95 is not None and p95 < MATERIAL_LOG_RHO:
+            return 0.0
+        return clip
+
     fps_key = ("ingraph_env_frames_per_sec" if backend == "ingraph"
                else "e2e_env_frames_per_sec")
     detectors = [
@@ -288,6 +300,30 @@ def default_detectors(backend: str = "host",
             name="peers_alive", metric="fleet/peers_alive",
             kind="threshold", direction="low", limit_from_first=True,
             warmup=0, window=False, pin=False),
+        # Learning-dynamics invariants over the devtel/learn gauges
+        # (runtime/learner.py learning_telemetry_spec).  Hard
+        # thresholds, not EWMA: an EWMA baseline ADAPTS to a policy
+        # that collapses before warm-up completes and never trips.
+        # entropy_frac is entropy normalized by the uniform policy's
+        # (~1.0 at init); < 5% means the policy is near-deterministic —
+        # the collapse the oversized-LR chaos run reproduces.
+        DetectorSpec(
+            name="entropy_collapse", metric="devtel/learn/entropy_frac",
+            kind="threshold", direction="low", limit=0.05, warmup=0),
+        # rho clip fraction > 0.9: V-trace is truncating nearly every
+        # importance weight — the learner has drifted so far off the
+        # behaviour data that updates are mostly thrown away (lower
+        # --replay_ratio, or shorten --target_update_interval under
+        # IMPACT).  The clip fraction counts strictly-above-threshold
+        # rhos, so a near-on-policy batch whose ratios all sit at
+        # 1.0001 reads 1.0 while the clip removes nothing — the
+        # detector therefore requires MATERIAL drift (log_rho_p95 >=
+        # learning.MATERIAL_LOG_RHO) before reading the fraction.
+        DetectorSpec(
+            name="clip_saturation",
+            metric="devtel/learn/rho_clip_fraction",
+            kind="threshold", direction="high", limit=0.9, warmup=0,
+            window=False, value_fn=_material_clip_fraction),
     ]
     if backend == "host":
         detectors.insert(1, DetectorSpec(

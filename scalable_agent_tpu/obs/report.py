@@ -414,6 +414,22 @@ def build_report(logdir: str,
             devtel[key] = value
     report["devtel"] = devtel or None
 
+    # The learning-dynamics plane (obs/learning.py over the
+    # devtel/learn/* gauges): metric snapshot, rule verdicts, and the
+    # measured staleness→clipping relationship from the per-interval
+    # metrics.jsonl rows (the number ROADMAP item 2's larger-batch
+    # push needs).
+    from scalable_agent_tpu.obs import learning
+    learn_snapshot = learning.extract_snapshot({
+        name: _value(families, name)
+        for name in learning.LEARNING_GAUGES.values()})
+    report["learning"] = {
+        "snapshot": learn_snapshot,
+        "verdicts": learning.derive_verdicts(learn_snapshot),
+        "staleness_clip": learning.staleness_clip_relationship(
+            learning.read_interval_rows(logdir)),
+    } if learn_snapshot else None
+
     # The run's incident timeline (obs/health.py anomalies.jsonl):
     # the report narrates what the health plane caught, with the
     # auto-profiled kernel verdict when a window completed.
@@ -603,6 +619,39 @@ def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
             parts.append(
                 f"mean length {devtel['env_episode_length_mean']:.1f}")
         lines.append("device telemetry: " + ", ".join(parts))
+
+    learning_section = report.get("learning")
+    if learning_section:
+        snapshot = learning_section["snapshot"]
+        lines.append("")
+        lines.append("learning dynamics (devtel/learn/*, "
+                     "obs/learning.py — full table via "
+                     "`python -m scalable_agent_tpu.obs.diagnose`)")
+        headline = []
+        for key, label in (("entropy_frac", "entropy"),
+                           ("kl", "KL"),
+                           ("ess_frac", "ESS"),
+                           ("explained_variance", "EV"),
+                           ("rho_clip_fraction", "rho-clip"),
+                           ("dead_torso_frac", "dead-torso")):
+            if key in snapshot:
+                headline.append(f"{label} {snapshot[key]:.3f}")
+        if headline:
+            lines.append("  " + "  ".join(headline))
+        ratios = [f"{group} {snapshot[f'update_ratio_{group}']:.3g}"
+                  for group in ("torso", "core", "heads")
+                  if f"update_ratio_{group}" in snapshot]
+        if ratios:
+            lines.append("  update/param ratios: " + "  ".join(ratios))
+        relation = learning_section.get("staleness_clip")
+        if relation:
+            lines.append("  staleness→clipping: "
+                         + relation["statement"])
+        for verdict in learning_section["verdicts"]:
+            lines.append(
+                f"  [{verdict['severity']}] {verdict['name']}: "
+                f"observed {verdict['observed']:.4g} vs limit "
+                f"{verdict['limit']:.4g} — {verdict['remedy']}")
 
     for artifact in report["ledger_artifacts"]:
         extra = " [TRUNCATED window]" if artifact["truncated"] else ""

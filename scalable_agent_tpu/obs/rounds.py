@@ -512,6 +512,13 @@ TRAJECTORY_METRICS: Tuple[Tuple[str, str, str], ...] = (
     ("learning_final_return", "learning final return", "return"),
     ("service_vs_grouped", "service vs grouped e2e", "x"),
     ("replay_sampled_vs_fresh_fps", "replay sampled vs fresh", "x"),
+    ("learning_overhead_frac_on_update",
+     "learning-dynamics plane share of update", "frac"),
+    ("learning_stats_overhead_frac",
+     "in-graph learning-stats overhead", "frac"),
+    ("learning_rho_clip_fraction", "V-trace rho clip fraction", "frac"),
+    ("learning_ess_frac", "importance-weight ESS", "frac"),
+    ("learning_entropy_frac", "policy entropy (normalized)", "frac"),
 )
 
 

@@ -146,6 +146,17 @@ def build_payload(logdir: str,
             "recent": anomalies[-tail:],
         },
     }
+    # The learning panel (obs/learning.py over devtel/learn/*):
+    # snapshot + live rule verdicts; None when the run predates the
+    # plane or disabled it.
+    from scalable_agent_tpu.obs import learning
+    learn_snapshot = learning.extract_snapshot({
+        name: _value(families, name)
+        for name in learning.LEARNING_GAUGES.values()})
+    payload["learning"] = {
+        "snapshot": learn_snapshot,
+        "verdicts": learning.derive_verdicts(learn_snapshot),
+    } if learn_snapshot else None
     return payload
 
 
@@ -194,6 +205,30 @@ def render(payload: dict) -> str:
                      f" ({event.get('event', event.get('kind', '?'))})")
         lines.append(
             f"fleet      peers {_fmt(fleet['peers_alive'])}{extra}")
+    learning_panel = payload.get("learning")
+    if learning_panel:
+        snapshot = learning_panel["snapshot"]
+        parts = []
+        for key, label, spec in (("entropy_frac", "entropy", ".3f"),
+                                 ("kl", "KL", ".4f"),
+                                 ("ess_frac", "ESS", ".3f"),
+                                 ("explained_variance", "EV", ".3f"),
+                                 ("rho_clip_fraction", "rho-clip", ".3f"),
+                                 ("dead_torso_frac", "dead", ".3f")):
+            if key in snapshot:
+                parts.append(f"{label} {format(snapshot[key], spec)}")
+        if parts:
+            lines.append("learning   " + "   ".join(parts))
+        ratios = [f"{group} {snapshot[f'update_ratio_{group}']:.2g}"
+                  for group in ("torso", "core", "heads")
+                  if f"update_ratio_{group}" in snapshot]
+        if ratios:
+            lines.append("           update/param " + "  ".join(ratios))
+        for verdict in learning_panel["verdicts"]:
+            lines.append(
+                f"  !! {verdict['name']} [{verdict['severity']}]: "
+                f"{_fmt(verdict['observed'], '.4g')} vs limit "
+                f"{_fmt(verdict['limit'], '.4g')}")
     health = payload["health"]
     lines.append(
         f"anomalies  {health['anomalies']} total"

@@ -50,11 +50,23 @@ class ImpactSurrogate(NamedTuple):
     clip_fraction: fraction of (t, b) cells where the clip bound was
         the active side of the min — the surrogate's own "how stale is
         my data" gauge.
+    log_ratio_mean / log_ratio_p95: location and tail of
+        log(π_θ/π_tgt) — the online→target drift the
+        ``target_update_interval`` dial controls (ISSUE 17).
+    ess_frac: effective sample size of the online→target importance
+        weights, (Σr)²/(N·Σr²) as a fraction of N.
+
+    The ISSUE-17 diagnostics are trailing fields with None defaults so
+    positional construction/unpacking of the original triple keeps
+    working.
     """
 
     loss: jax.Array
     ratio_mean: jax.Array
     clip_fraction: jax.Array
+    log_ratio_mean: Optional[jax.Array] = None
+    log_ratio_p95: Optional[jax.Array] = None
+    ess_frac: Optional[jax.Array] = None
 
 
 def surrogate_from_logits(
@@ -94,8 +106,19 @@ def surrogate_from_logits(
     objective = jnp.minimum(ratio * adv, clipped * adv)
     loss = -jnp.sum(objective)
     clip_active = (clipped * adv < ratio * adv)
+    log_ratio = lax.stop_gradient(lp_online - lp_target)
+    # ESS is scale-invariant in the weights: shift by the max log
+    # ratio before exponentiating so exp(2*log_ratio) can't overflow
+    # f32 and NaN the gauge on a badly drifted batch.
+    shifted = jnp.exp(log_ratio - jnp.max(log_ratio))
+    ess_frac = jnp.square(jnp.sum(shifted)) / jnp.maximum(
+        jnp.float32(log_ratio.size) * jnp.sum(jnp.square(shifted)),
+        jnp.float32(1e-30))
     return ImpactSurrogate(
         loss=loss,
         ratio_mean=jnp.mean(ratio),
         clip_fraction=jnp.mean(clip_active.astype(jnp.float32)),
+        log_ratio_mean=jnp.mean(log_ratio),
+        log_ratio_p95=jnp.quantile(log_ratio, 0.95),
+        ess_frac=ess_frac,
     )

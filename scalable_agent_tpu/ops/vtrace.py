@@ -35,9 +35,36 @@ import jax.numpy as jnp
 from jax import lax
 
 
+class VTraceDiagnostics(NamedTuple):
+    """Scalar off-policyness diagnostics of one V-trace batch (ISSUE 17:
+    the learning-dynamics plane).  All f32 scalars, stop-gradient'ed —
+    pure telemetry, never part of the loss tape:
+
+    - ``rho_clip_fraction`` / ``cs_clip_fraction`` /
+      ``pg_rho_clip_fraction``: fraction of cells whose rho exceeded
+      the rho-bar / 1.0 (the c-bar) / pg-rho-bar threshold — how much
+      of the correction V-trace actually truncated.
+    - ``log_rho_mean`` / ``log_rho_p95``: location and tail of the log
+      importance ratio (0 when on-policy).
+    - ``ess_frac``: effective sample size of the UNclipped importance
+      weights, (Σρ)²/(N·Σρ²), as a fraction of N — 1.0 on-policy,
+      → 1/N when one cell dominates.
+    """
+
+    rho_clip_fraction: jax.Array
+    cs_clip_fraction: jax.Array
+    pg_rho_clip_fraction: jax.Array
+    log_rho_mean: jax.Array
+    log_rho_p95: jax.Array
+    ess_frac: jax.Array
+
+
 class VTraceReturns(NamedTuple):
     vs: jax.Array
     pg_advantages: jax.Array
+    # Trailing default keeps positional unpacking (vs, pg) working for
+    # every pre-ISSUE-17 caller.
+    diagnostics: Optional[VTraceDiagnostics] = None
 
 
 class VTraceFromLogitsReturns(NamedTuple):
@@ -46,6 +73,49 @@ class VTraceFromLogitsReturns(NamedTuple):
     log_rhos: jax.Array
     behaviour_action_log_probs: jax.Array
     target_action_log_probs: jax.Array
+    diagnostics: Optional[VTraceDiagnostics] = None
+
+
+def importance_diagnostics(log_rhos,
+                           clip_rho_threshold: Optional[float] = 1.0,
+                           clip_pg_rho_threshold: Optional[float] = 1.0
+                           ) -> VTraceDiagnostics:
+    """Off-policyness diagnostics from log importance ratios.
+
+    Strict ``>`` comparisons: a rho exactly AT a threshold is returned
+    unchanged by ``minimum``, so only values the clip actually altered
+    count (an exactly-on-policy batch reports 0 clipped everywhere).
+    A ``None`` threshold disables that clip, so its fraction is 0.
+    """
+    log_rhos = lax.stop_gradient(jnp.asarray(log_rhos, jnp.float32))
+    rhos = jnp.exp(log_rhos)
+    zero = jnp.zeros((), jnp.float32)
+    rho_clip_fraction = (
+        jnp.mean((rhos > jnp.float32(clip_rho_threshold))
+                 .astype(jnp.float32))
+        if clip_rho_threshold is not None else zero)
+    pg_rho_clip_fraction = (
+        jnp.mean((rhos > jnp.float32(clip_pg_rho_threshold))
+                 .astype(jnp.float32))
+        if clip_pg_rho_threshold is not None else zero)
+    cs_clip_fraction = jnp.mean(
+        (rhos > jnp.float32(1.0)).astype(jnp.float32))
+    # ESS is scale-invariant in the weights, so shift by the max log
+    # ratio before exponentiating — exp(2*log_rho) overflows f32 from
+    # log_rho ~ 44, and one rogue trajectory would NaN the gauge.
+    shifted = jnp.exp(log_rhos - jnp.max(log_rhos))
+    sum_rho = jnp.sum(shifted)
+    sum_rho_sq = jnp.sum(jnp.square(shifted))
+    n = jnp.float32(log_rhos.size)
+    ess_frac = jnp.square(sum_rho) / jnp.maximum(
+        n * sum_rho_sq, jnp.float32(1e-30))
+    return VTraceDiagnostics(
+        rho_clip_fraction=rho_clip_fraction,
+        cs_clip_fraction=cs_clip_fraction,
+        pg_rho_clip_fraction=pg_rho_clip_fraction,
+        log_rho_mean=jnp.mean(log_rhos),
+        log_rho_p95=jnp.quantile(log_rhos, 0.95),
+        ess_frac=ess_frac)
 
 
 def log_probs_from_logits_and_actions(policy_logits, actions):
@@ -152,11 +222,16 @@ def from_importance_weights(
                 "scan_impl='time_sharded' needs the mesh argument")
         from scalable_agent_tpu.parallel import sequence
 
-        return sequence.from_importance_weights_sharded(
+        sharded = sequence.from_importance_weights_sharded(
             mesh, log_rhos, discounts, rewards, values, bootstrap_value,
             clip_rho_threshold=clip_rho_threshold,
             clip_pg_rho_threshold=clip_pg_rho_threshold,
             seq_axis=seq_axis)
+        # The diagnostics are elementwise reductions with no time
+        # recurrence, so they need none of the sequence sharding —
+        # compute them here and attach them to the delegated result.
+        return sharded._replace(diagnostics=importance_diagnostics(
+            log_rhos, clip_rho_threshold, clip_pg_rho_threshold))
     log_rhos = jnp.asarray(log_rhos, jnp.float32)
     discounts = jnp.asarray(discounts, jnp.float32)
     rewards = jnp.asarray(rewards, jnp.float32)
@@ -172,6 +247,9 @@ def from_importance_weights(
             f"log_rhos rank {log_rhos.ndim} - 1")
     if discounts.ndim != log_rhos.ndim or rewards.ndim != log_rhos.ndim:
         raise ValueError("discounts/rewards rank must match log_rhos rank")
+
+    diagnostics = importance_diagnostics(
+        log_rhos, clip_rho_threshold, clip_pg_rho_threshold)
 
     if scan_impl == "pallas":
         # Fused single-kernel path (ops/vtrace_pallas.py).  The kernel is
@@ -193,7 +271,8 @@ def from_importance_weights(
             interpret=jax.default_backend() != "tpu")
         return VTraceReturns(
             vs=lax.stop_gradient(vs.reshape(shape)),
-            pg_advantages=lax.stop_gradient(pg.reshape(shape)))
+            pg_advantages=lax.stop_gradient(pg.reshape(shape)),
+            diagnostics=diagnostics)
 
     a, deltas, rhos, _ = elementwise_prologue(
         log_rhos, discounts, rewards, values, bootstrap_value,
@@ -208,7 +287,8 @@ def from_importance_weights(
 
     return VTraceReturns(
         vs=lax.stop_gradient(vs),
-        pg_advantages=lax.stop_gradient(pg_advantages))
+        pg_advantages=lax.stop_gradient(pg_advantages),
+        diagnostics=diagnostics)
 
 
 def from_logits(
@@ -279,4 +359,5 @@ def from_logits(
         pg_advantages=vtrace_returns.pg_advantages,
         log_rhos=log_rhos,
         behaviour_action_log_probs=behaviour_action_log_probs,
-        target_action_log_probs=target_action_log_probs)
+        target_action_log_probs=target_action_log_probs,
+        diagnostics=vtrace_returns.diagnostics)

@@ -134,8 +134,11 @@ class InGraphTrainer:
             learner.mesh, batch_axis_index=0)
         self._env_tel_spec = env_telemetry_spec()
         self._tel_specs = [self._env_tel_spec]
-        if not learner.devtel_spec.empty:
-            self._tel_specs.append(learner.devtel_spec)
+        # Every learner-owned spec rides the same merged carry dict:
+        # the update counters AND the learning-dynamics plane
+        # (devtel/learn/*), whose in-update observes accumulate across
+        # all K megaloop iterations of a dispatch.
+        self._tel_specs.extend(learner.devtel_specs)
         self._tel_publisher = TelemetryPublisher(self._tel_specs)
         self.train_step = jax.jit(self._fused, donate_argnums=(0, 1))
         # Replayed-batch update: the learner's fresh=False

@@ -42,7 +42,10 @@ from scalable_agent_tpu.obs import (
 from scalable_agent_tpu.obs.device_telemetry import (
     DeviceTelemetry,
     TelemetryPublisher,
+    fetch_merged,
+    merge_init,
 )
+from scalable_agent_tpu.ops import distributions
 from scalable_agent_tpu.ops import impact as impact_lib
 from scalable_agent_tpu.ops import losses as losses_lib
 from scalable_agent_tpu.ops import vtrace
@@ -143,6 +146,113 @@ def learner_telemetry_spec() -> DeviceTelemetry:
     )
 
 
+# Per-layer-group telemetry buckets: the agent's param tree divides
+# into the conv torso ("convnet" + the optional instruction encoder),
+# the recurrent core ("core"/lstm), and the linear heads
+# ("policy_logits"/"baseline").  Keyed on flax module names so a new
+# head lands in "heads" and anything else defaults to the torso.
+LAYER_GROUPS = ("torso", "core", "heads")
+
+# Shared bucket edges for fraction-valued histograms ([0, 1] series:
+# clip fractions, ESS, normalized entropy).
+_FRACTION_EDGES = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99)
+
+
+def learning_telemetry_spec(loss: str = "vtrace") -> DeviceTelemetry:
+    """The learning-dynamics instrument set (ISSUE 17): off-policy clip
+    diagnostics, policy entropy/KL, value explained-variance, and
+    per-layer-group optimizer health — all accumulated INSIDE the
+    jitted update in the same donated devtel buffers as
+    ``learner_telemetry_spec`` (merged via ``merge_init``), fetched in
+    the one existing log-interval transfer.
+
+    Gauges carry the newest update's value (what the health detectors
+    and ``obs.watch`` read); histograms additionally aggregate across
+    every update between fetches — in particular all K updates of an
+    ``--updates_per_dispatch=K`` megaloop dispatch, where the metrics
+    dict only surfaces the last update's scalars.
+    """
+    spec = DeviceTelemetry("learn")
+    for name, help_text in (
+        ("entropy_frac",
+         "policy entropy / max entropy (1.0 = uniform; ~0 = collapsed)"),
+        ("kl",
+         "KL(behaviour || learner) — how far the learner has moved off "
+         "the data-generating policy"),
+        ("ess_frac",
+         "effective sample size of the V-trace importance weights as a "
+         "fraction of the batch (1.0 = on-policy)"),
+        ("explained_variance",
+         "1 - Var(vs - baseline)/Var(vs): how much of the value target "
+         "the baseline explains (<=0 = diverging critic)"),
+        ("rho_clip_fraction",
+         "fraction of V-trace rhos cut by clip_rho_threshold"),
+        ("cs_clip_fraction",
+         "fraction of V-trace cs cut by the c-bar clip"),
+        ("pg_rho_clip_fraction",
+         "fraction of pg-rhos cut by clip_pg_rho_threshold"),
+        ("log_rho_mean",
+         "mean log importance ratio log(pi/mu) (0 = on-policy)"),
+        ("log_rho_p95",
+         "p95 log importance ratio — the off-policy tail"),
+        ("dead_torso_frac",
+         "fraction of conv-torso output units at <=0 across the whole "
+         "batch (dead ReLUs)"),
+    ):
+        spec.gauge(name, help_text)
+    for group in LAYER_GROUPS:
+        spec.gauge(f"grad_norm_{group}",
+                   f"gradient norm over the {group} param group")
+        spec.gauge(f"param_norm_{group}",
+                   f"param norm of the {group} param group")
+        spec.gauge(f"update_ratio_{group}",
+                   f"|lr-scaled update| / |param| for the {group} group "
+                   "(healthy ~1e-4..1e-2)")
+    if loss == "impact":
+        # ISSUE 17 satellite: the IMPACT ratio series ride HISTOGRAMS
+        # (not just the per-update metrics dict) so a megaloop dispatch
+        # aggregates all K updates instead of surfacing only the last.
+        spec.histogram(
+            "impact_ratio",
+            (0.5, 0.8, 0.9, 0.95, 1.0, 1.05, 1.1, 1.25, 2.0),
+            "per-update mean IMPACT ratio pi_theta/pi_tgt (~1 = online "
+            "net hugging its target anchor)")
+        spec.histogram(
+            "impact_clip_fraction", _FRACTION_EDGES,
+            "per-update fraction of cells where the IMPACT clip bound "
+            "was active")
+        spec.gauge("impact_log_ratio_p95",
+                   "p95 of log(pi_theta/pi_tgt) — online-to-target "
+                   "drift tail")
+        spec.gauge("impact_ess_frac",
+                   "ESS fraction of the online-to-target importance "
+                   "weights")
+    return spec
+
+
+def _torso_filter(mdl, _method_name) -> bool:
+    """flax capture_intermediates filter: only the conv torso output."""
+    return mdl.name == "convnet"
+
+
+def _dead_unit_fraction(captured) -> jax.Array:
+    """Fraction of torso output units that are <= 0 for EVERY element
+    of the [T*B] batch — dead ReLUs the optimizer can no longer reach."""
+    conv_out = captured["intermediates"]["convnet"]["__call__"][0]
+    conv_out = jax.lax.stop_gradient(jnp.asarray(conv_out, jnp.float32))
+    return jnp.mean(jnp.all(conv_out <= 0.0, axis=0).astype(jnp.float32))
+
+
+def _layer_group(path) -> str:
+    """Map a param-tree path to its LAYER_GROUPS bucket."""
+    keys = {str(getattr(entry, "key", entry)) for entry in path}
+    if "core" in keys:
+        return "core"
+    if "policy_logits" in keys or "baseline" in keys:
+        return "heads"
+    return "torso"
+
+
 def _make_optimizer(hp: LearnerHyperparams) -> optax.GradientTransformation:
     # lr=1.0 here; the decayed lr is applied inside the update so it can be
     # keyed on env frames rather than update count (resume-exact, reference
@@ -185,6 +295,7 @@ class Learner:
         transport: str = "per_leaf",
         finite_guard: bool = True,
         device_telemetry: bool = True,
+        learn_telemetry: bool = True,
         loss: str = "vtrace",
         target_update_interval: int = 100,
         impact_clip_epsilon: float = 0.3,
@@ -274,9 +385,22 @@ class Learner:
         self._devtel_spec = (learner_telemetry_spec()
                              if self._devtel_enabled
                              else DeviceTelemetry("learner"))
-        self._devtel = self._place_replicated(self._devtel_spec.init())
+        # Learning-dynamics plane (ISSUE 17): a second spec in its own
+        # "learn" namespace, merged into the SAME donated pytree —
+        # same buffers, same single log-interval fetch, zero new syncs.
+        self._learn_enabled = bool(learn_telemetry) and self._devtel_enabled
+        self._learn_spec = (learning_telemetry_spec(loss)
+                            if self._learn_enabled
+                            else DeviceTelemetry("learn"))
+        # Normalizer for entropy_frac: the distribution's max entropy
+        # (sum of log cell sizes — the joint entropy of the uniform
+        # policy).
+        self._max_entropy = max(
+            float(sum(np.log(s) for s in agent.dist_spec.sizes)), 1e-6)
+        self._devtel = self._place_replicated(
+            merge_init(self.devtel_specs))
         self._devtel_publisher = (
-            TelemetryPublisher(self._devtel_spec)
+            TelemetryPublisher(self.devtel_specs)
             if self._devtel_enabled else None)
         self._traj_shardings = traj_shardings
         # Host->device trajectory placement strategy: "per_leaf" (one
@@ -343,6 +467,19 @@ class Learner:
         return self._devtel_spec
 
     @property
+    def learn_spec(self) -> DeviceTelemetry:
+        """The learning-dynamics spec (``devtel/learn/*``; empty when
+        disabled)."""
+        return self._learn_spec
+
+    @property
+    def devtel_specs(self):
+        """Every non-empty spec riding this learner's donated telemetry
+        pytree (learner counters + the learning-dynamics plane)."""
+        return [spec for spec in (self._devtel_spec, self._learn_spec)
+                if not spec.empty]
+
+    @property
     def device_telemetry(self):
         """The CURRENT device-resident telemetry buffers.  Callers
         driving ``_update`` directly (bench AOT path, in-graph trainer)
@@ -371,7 +508,7 @@ class Learner:
         driver calls it at log-interval cadence.  None when disabled."""
         if not self._devtel_enabled:
             return None
-        return self._devtel_spec.fetch(self._devtel)
+        return fetch_merged(self.devtel_specs, self._devtel)
 
     def publish_device_telemetry(self) -> Optional[Dict[str, np.ndarray]]:
         """Fetch + fold into the metrics registry (``devtel/learner/*``
@@ -505,13 +642,28 @@ class Learner:
     def _loss_vtrace(self, params, trajectory: Trajectory):
         hp = self._hp
         # Target-policy unroll over the whole T+1 window (reference:
-        # experiment.py:358-365).
-        (target_logits, baselines), _ = self._agent.apply(
-            params,
-            trajectory.agent_outputs.action,
-            trajectory.env_outputs,
-            trajectory.agent_state,
-        )
+        # experiment.py:358-365).  With the learning-dynamics plane on,
+        # the same unroll also captures the torso output (flax
+        # capture_intermediates) for the dead-unit gauge — no second
+        # forward.
+        dead_torso = None
+        if self._learn_enabled:
+            ((target_logits, baselines), _), captured = self._agent.apply(
+                params,
+                trajectory.agent_outputs.action,
+                trajectory.env_outputs,
+                trajectory.agent_state,
+                capture_intermediates=_torso_filter,
+                mutable=["intermediates"],
+            )
+            dead_torso = _dead_unit_fraction(captured)
+        else:
+            (target_logits, baselines), _ = self._agent.apply(
+                params,
+                trajectory.agent_outputs.action,
+                trajectory.env_outputs,
+                trajectory.agent_state,
+            )
         # The last baseline is the bootstrap; then drop the last target
         # output and the first behaviour/env entry (reference:
         # experiment.py:368-375 — "use last baseline value for
@@ -554,12 +706,17 @@ class Learner:
             target_logits, dist_spec=dist_spec)
         total = (pg_loss + hp.baseline_cost * baseline_loss
                  + hp.entropy_cost * entropy_loss)
-        return total, {
+        metrics = {
             "total_loss": total,
             "policy_gradient_loss": pg_loss,
             "baseline_loss": baseline_loss,
             "entropy_loss": entropy_loss,
         }
+        if self._learn_enabled:
+            metrics.update(self._learning_metrics(
+                vt, behaviour.policy_logits, target_logits, baselines,
+                dist_spec, dead_torso))
+        return total, metrics
 
     def _loss_impact(self, params, trajectory: Trajectory, target_params):
         """IMPACT clipped-target surrogate (ops/impact.py): V-trace
@@ -569,12 +726,26 @@ class Learner:
         π_θ against π_tgt.  Baseline/entropy terms keep the vtrace
         branch's shape so the cost hyperparameters transfer."""
         hp = self._hp
-        (online_logits, baselines), _ = self._agent.apply(
-            params,
-            trajectory.agent_outputs.action,
-            trajectory.env_outputs,
-            trajectory.agent_state,
-        )
+        dead_torso = None
+        if self._learn_enabled:
+            # Capture the ONLINE net's torso output (the params being
+            # optimized) for the dead-unit gauge.
+            ((online_logits, baselines), _), captured = self._agent.apply(
+                params,
+                trajectory.agent_outputs.action,
+                trajectory.env_outputs,
+                trajectory.agent_state,
+                capture_intermediates=_torso_filter,
+                mutable=["intermediates"],
+            )
+            dead_torso = _dead_unit_fraction(captured)
+        else:
+            (online_logits, baselines), _ = self._agent.apply(
+                params,
+                trajectory.agent_outputs.action,
+                trajectory.env_outputs,
+                trajectory.agent_state,
+            )
         # Second (target-net) unroll: the staleness anchor.  Costs one
         # extra forward — the price of tolerating arbitrarily stale
         # behaviour data.
@@ -625,13 +796,53 @@ class Learner:
             online_logits, dist_spec=dist_spec)
         total = (surrogate.loss + hp.baseline_cost * baseline_loss
                  + hp.entropy_cost * entropy_loss)
-        return total, {
+        metrics = {
             "total_loss": total,
             "policy_gradient_loss": surrogate.loss,
             "baseline_loss": baseline_loss,
             "entropy_loss": entropy_loss,
             "impact_ratio_mean": surrogate.ratio_mean,
             "impact_clip_fraction": surrogate.clip_fraction,
+        }
+        if self._learn_enabled:
+            metrics.update(self._learning_metrics(
+                vt, behaviour.policy_logits, online_logits, baselines,
+                dist_spec, dead_torso))
+            metrics["impact_log_ratio_mean"] = surrogate.log_ratio_mean
+            metrics["impact_log_ratio_p95"] = surrogate.log_ratio_p95
+            metrics["impact_ess_frac"] = surrogate.ess_frac
+        return total, metrics
+
+    def _learning_metrics(self, vt, behaviour_logits, online_logits,
+                          baselines, dist_spec, dead_torso
+                          ) -> Dict[str, jax.Array]:
+        """The learning-dynamics scalars (ISSUE 17): V-trace clip/ESS
+        diagnostics, policy entropy (absolute + normalized),
+        behaviour→learner KL, value explained-variance, dead torso
+        units.  All stop-gradiented — pure observation, the loss value
+        and its gradient are bit-identical with the plane on or off."""
+        sg = jax.lax.stop_gradient
+        diag = vt.diagnostics
+        online = sg(online_logits)
+        entropy = jnp.mean(distributions.entropy(online, dist_spec))
+        kl = jnp.mean(distributions.kl_divergence(
+            sg(behaviour_logits), online, dist_spec))
+        vs = sg(vt.vs)
+        explained_variance = 1.0 - (
+            jnp.var(vs - sg(baselines))
+            / jnp.maximum(jnp.var(vs), jnp.float32(1e-8)))
+        return {
+            "policy_entropy": entropy,
+            "entropy_frac": entropy / jnp.float32(self._max_entropy),
+            "behaviour_kl": kl,
+            "explained_variance": explained_variance,
+            "rho_clip_fraction": diag.rho_clip_fraction,
+            "cs_clip_fraction": diag.cs_clip_fraction,
+            "pg_rho_clip_fraction": diag.pg_rho_clip_fraction,
+            "log_rho_mean": diag.log_rho_mean,
+            "log_rho_p95": diag.log_rho_p95,
+            "ess_frac": diag.ess_frac,
+            "dead_torso_frac": dead_torso,
         }
 
     def _update_impl(self, state: TrainState, trajectory: Trajectory,
@@ -739,7 +950,67 @@ class Learner:
             if self._finite_guard:
                 devtel = spec.inc(devtel, "skipped",
                                   metrics["update_skipped"])
+        if self._learn_enabled:
+            devtel = self._accumulate_learning_telemetry(
+                devtel, metrics, grads, updates, params)
         return new_state, devtel, metrics
+
+    def _accumulate_learning_telemetry(self, devtel, metrics, grads,
+                                       updates, params):
+        """Fold the learning-dynamics scalars into the donated devtel
+        pytree inside the update program — gauge sets, histogram
+        observes, and three tree reductions per layer group; no host
+        sync (the same contract as the non-finite counters, proven by
+        the transfer-guard tests)."""
+        lspec = self._learn_spec
+        for name in ("entropy_frac", "ess_frac", "explained_variance",
+                     "rho_clip_fraction", "cs_clip_fraction",
+                     "pg_rho_clip_fraction", "log_rho_mean",
+                     "log_rho_p95", "dead_torso_frac"):
+            devtel = lspec.set(devtel, name, metrics[name])
+        devtel = lspec.set(devtel, "kl", metrics["behaviour_kl"])
+        if self._loss_name == "impact":
+            # Satellite fix: histograms aggregate EVERY update between
+            # fetches — under --updates_per_dispatch=K the metrics dict
+            # only surfaces the last of the K scan iterations, but
+            # these observes run inside each iteration on the carried
+            # devtel dict, so count/sum/mean cover all K.
+            for hist, key in (("impact_ratio", "impact_ratio_mean"),
+                              ("impact_clip_fraction",
+                               "impact_clip_fraction")):
+                value = metrics[key]
+                devtel = lspec.observe(devtel, hist, value,
+                                       where=jnp.isfinite(value))
+            devtel = lspec.set(devtel, "impact_log_ratio_p95",
+                               metrics["impact_log_ratio_p95"])
+            devtel = lspec.set(devtel, "impact_ess_frac",
+                               metrics["impact_ess_frac"])
+        # Per-layer-group optimizer health: grads/updates/params share
+        # one treedef, so a single flatten-with-path keys all three.
+        zero = jnp.zeros((), jnp.float32)
+        acc = {group: [zero, zero, zero] for group in LAYER_GROUPS}
+        flat_grads, _ = jax.tree_util.tree_flatten_with_path(grads)
+        flat_updates = jax.tree_util.tree_leaves(updates)
+        flat_params = jax.tree_util.tree_leaves(params)
+        for (path, g), u, p in zip(flat_grads, flat_updates, flat_params):
+            group = acc[_layer_group(path)]
+            group[0] = group[0] + jnp.sum(
+                jnp.square(jnp.asarray(g, jnp.float32)))
+            group[1] = group[1] + jnp.sum(
+                jnp.square(jnp.asarray(u, jnp.float32)))
+            group[2] = group[2] + jnp.sum(
+                jnp.square(jnp.asarray(p, jnp.float32)))
+        for name, (g_sq, u_sq, p_sq) in acc.items():
+            param_norm = jnp.sqrt(p_sq)
+            devtel = lspec.set(devtel, f"grad_norm_{name}",
+                               jnp.sqrt(g_sq))
+            devtel = lspec.set(devtel, f"param_norm_{name}", param_norm)
+            # ``updates`` is already lr-scaled, so this is the actual
+            # step taken relative to the weights it moved.
+            devtel = lspec.set(
+                devtel, f"update_ratio_{name}",
+                jnp.sqrt(u_sq) / (param_norm + jnp.float32(1e-8)))
+        return devtel
 
     def update(self, state: TrainState, trajectory: Trajectory,
                fresh: bool = True

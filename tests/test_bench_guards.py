@@ -987,3 +987,91 @@ class TestHealthRegressionGuard:
         for key in bench.HEALTH_GUARD_KEYS:
             assert diag.get(key) is not None, key
         assert diag["health_frac_on_update"] < bench.HEALTH_BUDGET_FRAC
+
+
+class TestLearningRegressionGuard:
+    """ISSUE 17 satellite: the learning-dynamics plane (in-graph stats
+    + devtel accumulate per update, fetch/publish at the log cadence)
+    must stay under 1% of the update stage — binding on TPU, advisory
+    on the CPU fallback — with obs-guard-style missing-key
+    protection."""
+
+    def _write_prev(self, tmp_path, **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": "tpu", **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "learning_stats_us": 40.0,
+                "learning_accumulate_us": 2.0,
+                "learning_fetch_us": 300.0,
+                "learning_publish_us": 60.0}
+        diag.update(kwargs)
+        return diag
+
+    def test_over_budget_fails_on_tpu(self, tmp_path):
+        diag = self._diag(learning_overhead_frac_on_update=0.05)
+        bench.learning_regression_guard(diag, bench_dir=str(tmp_path))
+        assert any("LEARNING" in e and "1%" in e
+                   for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self, tmp_path):
+        diag = self._diag(platform="cpu",
+                          learning_overhead_frac_on_update=0.05)
+        bench.learning_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert any("LEARNING" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self, tmp_path):
+        diag = self._diag(learning_overhead_frac_on_update=0.0005)
+        bench.learning_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.learning_regression_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, learning_overhead_frac_on_update=0.0005,
+            learning_stats_overhead_frac=0.0004,
+            learning_worst_case_frac_on_update=0.01,
+            learning_stats_us=35.0, learning_accumulate_us=2.0,
+            learning_fetch_us=250.0, learning_publish_us=50.0)
+        diag = {"errors": [], "platform": "tpu"}  # stage vanished
+        bench.learning_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "LEARNING REGRESSION" in e and "missing" in e]
+        assert len(missing) == len(bench.LEARNING_GUARD_KEYS)
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path,
+                                     learning_stats_us=35.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.learning_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []
+
+    def test_runs_against_real_committed_artifacts(self):
+        diag = {"errors": [],
+                "learning_overhead_frac_on_update": 1e-5}
+        bench.learning_regression_guard(diag)
+        assert not [e for e in diag["errors"]
+                    if "LEARNING REGRESSION" in e]
+
+    def test_suite_emits_trajectory_readings(self):
+        """bench_learning_dynamics must publish the off-policy
+        readings ``rounds report`` carries (TRAJECTORY_METRICS) plus
+        every guarded key when sec_per_update is known."""
+        diag = {"errors": [], "platform": "cpu", "stage": "",
+                "sec_per_update": 0.05}
+        bench.bench_learning_dynamics(diag)
+        for key in bench.LEARNING_GUARD_KEYS:
+            assert diag.get(key) is not None, key
+        for key in ("learning_rho_clip_fraction", "learning_ess_frac",
+                    "learning_entropy_frac"):
+            assert 0.0 <= diag[key] <= 1.0, key

@@ -544,8 +544,10 @@ class TestReportCli:
     def test_report_errors_without_artifacts(self, tmp_path, capsys):
         from scalable_agent_tpu.obs import report
 
-        assert report.main([str(tmp_path)]) == 1
-        assert "no metrics" in capsys.readouterr().out
+        # Operator-error convention shared with obs.watch/obs.diagnose:
+        # exit 2, one diagnostic line on stderr.
+        assert report.main([str(tmp_path)]) == 2
+        assert "no metrics" in capsys.readouterr().err
 
     def _append_replay_series(self, logdir, replayed_p95):
         with open(os.path.join(logdir, "metrics.prom"), "a") as f:
