@@ -64,14 +64,37 @@ class Config:
 
     # -- TPU-native knobs (no reference equivalent)
     torso_type: str = "shallow"  # shallow | resnet
-    compute_dtype: str = "bfloat16"  # conv compute dtype on TPU
+    # Activation/matmul dtype END-TO-END (torso, LSTM core, heads):
+    # params, loss, V-trace, and optimizer reductions stay f32
+    # regardless (models/agent.py documents the full policy).
+    compute_dtype: str = "bfloat16"
     # LSTM core: auto | xla | pallas — auto picks the fused Pallas
     # unroll (ops/lstm_pallas.py) on a single-device TPU mesh, the
     # nn.scan path elsewhere.  Param trees are identical either way.
     core_impl: str = "auto"
-    # Pallas-core matmul operand precision: float32 (exact parity) or
-    # bfloat16 (2x MXU rate, f32 accumulation).  Ignored by core "xla".
-    core_matmul_dtype: str = "float32"
+    # Pallas-core matmul operand precision: auto | float32 | bfloat16.
+    # "auto" follows the ONE dtype policy: the pallas core's matmuls
+    # run at compute_dtype (bf16 operands, f32 accumulation — the
+    # parity-proven recipe), while the xla core always trains at the
+    # f32 params' precision.  Explicit values decouple the two.
+    core_matmul_dtype: str = "auto"
+    # Stem-conv grad-W lowering: auto | xla | pallas.  "pallas" swaps
+    # ONLY the stem's weight gradient for the im2col MXU kernel
+    # (ops/conv_pallas.py) — the named worst kernel in the roofline
+    # ledger (conv0_gradw, 0.107 MFU).  "auto" = pallas on TPU, xla
+    # elsewhere (the lstm_pallas precedent; off-TPU the kernel would
+    # run interpreted).  Param trees are identical either way.
+    conv_backend: str = "auto"
+    # Fused single-forward loss (runtime/learner.py): one unroll feeds
+    # both the behaviour-comparison quantities and the differentiated
+    # loss outputs.  False compiles the two-pass reference shape —
+    # bench_kernel_war's baseline, not a production setting.
+    fused_forward: bool = True
+    # Rematerialize the torso in the backward pass: auto | on | off.
+    # "auto" = on for TPU runs (keeps the fused single-forward update
+    # flat on peak activation memory at B=256), off elsewhere.
+    # Numerically identity; trades a torso recompute for memory.
+    remat_torso: str = "auto"
     use_instruction: bool = False
     # (the actor-group count is derived: num_actors // batch_size — each
     # group is one learner batch; >= 2 groups overlap env-sim with TPU

@@ -97,7 +97,12 @@ from scalable_agent_tpu.envs import (
 )
 from scalable_agent_tpu.envs import dmlab30
 from scalable_agent_tpu.envs.spec import TensorSpec
-from scalable_agent_tpu.models import ImpalaAgent, actor_step, initial_state
+from scalable_agent_tpu.models import (
+    CONV_BACKENDS,
+    ImpalaAgent,
+    actor_step,
+    initial_state,
+)
 from scalable_agent_tpu.obs import (
     MetricsHTTPServer,
     MetricsWriter,
@@ -201,19 +206,59 @@ def resolve_core_impl(config: Config) -> str:
     return "pallas" if fused_kernels_profitable(num_devices=num) else "xla"
 
 
+def resolve_conv_backend(config: Config) -> str:
+    """"auto" = the Pallas grad-W stem on TPU, plain XLA elsewhere
+    (off-TPU the kernel would run under the Pallas interpreter — the
+    same code path tier-1 tests, but not a production lowering)."""
+    if config.conv_backend != "auto":
+        if config.conv_backend not in CONV_BACKENDS:
+            raise ValueError(
+                f"conv_backend must be auto or one of {CONV_BACKENDS}, "
+                f"got {config.conv_backend!r}")
+        return config.conv_backend
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def resolve_core_matmul_dtype(config: Config, core_impl: str) -> str:
+    """"auto" follows the dtype policy: the pallas core's MXU matmuls
+    run at compute_dtype (f32 accumulation either way); the xla core
+    always trains at the f32 params' precision, so auto resolves to
+    float32 there and the flag stays inert."""
+    if config.core_matmul_dtype != "auto":
+        return config.core_matmul_dtype
+    if core_impl != "pallas":
+        return "float32"
+    return ("bfloat16"
+            if jnp.dtype(config.compute_dtype) == jnp.dtype(jnp.bfloat16)
+            else "float32")
+
+
+def resolve_remat_torso(config: Config) -> bool:
+    """"auto" = remat on TPU (where the fused single-forward update's
+    peak activation memory at B=256 is the concern), off elsewhere."""
+    if config.remat_torso not in ("auto", "on", "off"):
+        raise ValueError(
+            f"remat_torso must be auto, on, or off, got "
+            f"{config.remat_torso!r}")
+    if config.remat_torso != "auto":
+        return config.remat_torso == "on"
+    return jax.default_backend() == "tpu"
+
+
 def build_agent(config: Config, action_space) -> ImpalaAgent:
     """Policy heads derive from the probed action space — one Discrete
     head or a composite tuple-categorical (ops/distributions.py)."""
-    if config.core_matmul_dtype not in ("float32", "bfloat16"):
-        raise ValueError(
-            f"core_matmul_dtype must be float32 or bfloat16, got "
-            f"{config.core_matmul_dtype!r}")
     core_impl = resolve_core_impl(config)
-    if config.core_matmul_dtype != "float32" and core_impl != "pallas":
+    core_matmul_dtype = resolve_core_matmul_dtype(config, core_impl)
+    if core_matmul_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"core_matmul_dtype must be auto, float32, or bfloat16, "
+            f"got {core_matmul_dtype!r}")
+    if core_matmul_dtype != "float32" and core_impl != "pallas":
         import warnings
 
         warnings.warn(
-            f"core_matmul_dtype={config.core_matmul_dtype!r} only "
+            f"core_matmul_dtype={core_matmul_dtype!r} only "
             f"affects the pallas core; this run resolves to "
             f"core_impl={core_impl!r} and trains at float32",
             stacklevel=2)
@@ -223,7 +268,9 @@ def build_agent(config: Config, action_space) -> ImpalaAgent:
         use_instruction=config.use_instruction,
         compute_dtype=jnp.dtype(config.compute_dtype),
         core_impl=core_impl,
-        core_matmul_dtype=config.core_matmul_dtype,
+        core_matmul_dtype=core_matmul_dtype,
+        conv_backend=resolve_conv_backend(config),
+        remat_torso=resolve_remat_torso(config),
     )
 
 
@@ -1641,7 +1688,8 @@ def build_training_learner(config: Config, agent: ImpalaAgent):
                    learn_telemetry=config.learn_telemetry,
                    loss=config.loss,
                    target_update_interval=config.target_update_interval,
-                   impact_clip_epsilon=config.impact_clip_epsilon)
+                   impact_clip_epsilon=config.impact_clip_epsilon,
+                   fused_forward=config.fused_forward)
 
 
 def build_replay(config: Config, learner: Learner):

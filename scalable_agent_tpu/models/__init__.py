@@ -4,3 +4,4 @@ from scalable_agent_tpu.models.agent import (
     initial_state,
 )
 from scalable_agent_tpu.models.instruction import hash_instruction
+from scalable_agent_tpu.models.networks import CONV_BACKENDS

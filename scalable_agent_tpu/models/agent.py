@@ -154,6 +154,14 @@ class ImpalaAgent(nn.Module):
     torso_type: str = "shallow"
     use_instruction: bool = False
     core_size: int = CORE_SIZE
+    # The ONE compute-dtype policy (f32 default; bfloat16 on TPU via
+    # --compute_dtype): params stay float32, the torso/concat/head
+    # matmuls run in compute_dtype, and the agent's OUTPUTS
+    # (policy_logits, baseline) are upcast to f32 so every loss /
+    # V-trace / optimizer reduction downstream stays f32.  The XLA
+    # LSTM core is the one documented exception: flax's cell promotes
+    # to the f32 params' dtype (the Pallas core's matmul precision is
+    # core_matmul_dtype's job instead).
     compute_dtype: Any = jnp.float32
     # LSTM core implementation: "xla" = nn.scan over OptimizedLSTMCell;
     # "pallas" = the fused single-program unroll (ops/lstm_pallas.py).
@@ -163,6 +171,17 @@ class ImpalaAgent(nn.Module):
     # "float32" (bit-exact vs the flax cell) or "bfloat16" (2x MXU
     # rate, f32 accumulation).  Ignored by the xla core.
     core_matmul_dtype: str = "float32"
+    # Stem-conv grad-W lowering: "xla" (plain nn.Conv) or "pallas"
+    # (ops/conv_pallas.py im2col MXU kernel; interpret mode off-TPU).
+    # Identical parameter trees — checkpoints are interchangeable.
+    conv_backend: str = "xla"
+    # Rematerialize the torso in the backward pass (jax.checkpoint via
+    # nn.remat).  The fused single-forward update keeps the behaviour
+    # logits and the loss's outputs from ONE unroll; remat keeps that
+    # from costing peak activation memory at B=256.  Default OFF so
+    # the default-path jaxpr (and the golden-loss anchor) is
+    # untouched; the learner turns it on with the fused forward.
+    remat_torso: bool = False
     # Composite policies: a TupleSpace mixing Discrete/Discretized
     # components (reference: TupleActionDistribution,
     # algorithms/utils/action_distributions.py:111-201).  When unset, the
@@ -205,9 +224,16 @@ class ImpalaAgent(nn.Module):
         # ---- Torso over the merged [T*B] batch (reference: _torso,
         # experiment.py:148-198, but batched over all timesteps at once).
         flat = lambda x: x.reshape((unroll_len * batch,) + x.shape[2:])
-        torso = TORSOS[self.torso_type](dtype=self.compute_dtype,
-                                        name="convnet")
-        conv_out = torso(flat(frame))  # [T*B, 256]
+        torso_cls = TORSOS[self.torso_type]
+        if self.remat_torso:
+            # jax.checkpoint on the torso: activations are recomputed
+            # in the backward pass instead of living across the whole
+            # unroll+loss — what keeps the fused single-forward update
+            # flat on peak memory at B=256.
+            torso_cls = nn.remat(torso_cls)
+        torso = torso_cls(dtype=self.compute_dtype,
+                          conv_backend=self.conv_backend, name="convnet")
+        conv_out = torso(flat(frame))  # [T*B, 256] compute_dtype
 
         clipped_reward = jnp.clip(
             jnp.asarray(flat(reward), jnp.float32), -1.0, 1.0)[:, None]
@@ -218,7 +244,12 @@ class ImpalaAgent(nn.Module):
             instruction = observation.instruction
             parts.append(
                 InstructionEncoder(name="instruction")(flat(instruction)))
-        torso_out = jnp.concatenate(parts, axis=-1)
+        # Mixed-dtype concat promotes to f32; the policy casts back so
+        # the core consumes compute_dtype activations (identity under
+        # the f32 default — the golden anchor sees the same jaxpr
+        # values).
+        torso_out = jnp.asarray(
+            jnp.concatenate(parts, axis=-1), self.compute_dtype)
         torso_out = torso_out.reshape((unroll_len, batch, -1))
 
         # ---- LSTM core: one fused scan over time with done-reset
@@ -247,10 +278,17 @@ class ImpalaAgent(nn.Module):
         # merged batch.
         core_flat = core_outputs.reshape((unroll_len * batch, -1))
         num_logits = self.num_logits
-        policy_logits = nn.Dense(num_logits, name="policy_logits")(
-            core_flat).reshape((unroll_len, batch, num_logits))
-        baseline = nn.Dense(1, name="baseline")(core_flat).reshape(
-            (unroll_len, batch))
+        # Heads run at compute_dtype; the OUTPUTS are upcast to f32 —
+        # the loss/V-trace/optimizer side of the dtype policy never
+        # sees bf16 (under the f32 default both casts are identities).
+        policy_logits = jnp.asarray(
+            nn.Dense(num_logits, dtype=self.compute_dtype,
+                     name="policy_logits")(core_flat),
+            jnp.float32).reshape((unroll_len, batch, num_logits))
+        baseline = jnp.asarray(
+            nn.Dense(1, dtype=self.compute_dtype, name="baseline")(
+                core_flat),
+            jnp.float32).reshape((unroll_len, batch))
         return (policy_logits, baseline), new_state
 
 

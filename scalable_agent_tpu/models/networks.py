@@ -14,11 +14,22 @@ every conv/matmul hits the MXU with the largest possible batch; compute can
 run in bfloat16 (``dtype``) with float32 params.
 """
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+# Stem-conv backends every torso accepts (``--conv_backend``).  "xla"
+# is the plain nn.Conv lowering; "pallas" swaps ONLY the weight
+# gradient for the im2col MXU kernel (ops/conv_pallas.py) — forward
+# math is identical, parameter trees are identical, checkpoints are
+# interchangeable.  The (negative-result) space-to-depth formulation
+# is deliberately NOT in this registry: it stays reachable via
+# ``ShallowConvTorso(space_to_depth=True)`` as documentation of the
+# measurement (see _SpaceToDepthFirstConv), but it is retired from
+# the flag surface — BENCH_NOTES' round-5 conv table is why.
+CONV_BACKENDS = ("xla", "pallas")
 
 
 def _normalize_frame(frame, dtype):
@@ -93,27 +104,89 @@ class _SpaceToDepthFirstConv(nn.Module):
         return out + b
 
 
+class PallasStemConv(nn.Module):
+    """A SAME-padded strided conv whose weight gradient is the Pallas
+    im2col kernel (ops/conv_pallas.py stem_conv).  Forward and input
+    gradient are XLA's own — numerically this IS the ``nn.Conv`` it
+    replaces; only d/dW's lowering changes.  Parameter tree, shapes,
+    and initializers are IDENTICAL to
+    ``nn.Conv(features, (k, k), strides=s, padding="SAME")`` — kernel
+    [k, k, C, F] + bias under the same module name — so checkpoints
+    are interchangeable both ways (the _SpaceToDepthFirstConv
+    contract, tests/test_conv_pallas.py pins it).
+
+    Runs the identical kernel under the Pallas interpreter off-TPU, so
+    CPU tier-1 exercises the same code path (the lstm_pallas.py
+    precedent).  MXU operand precision follows ``dtype``: a bfloat16
+    module runs bf16 operands with f32 accumulation; override with
+    ``matmul_dtype`` to decouple them."""
+
+    features: int
+    kernel_size: int = 8
+    stride: int = 4
+    dtype: Any = jnp.float32
+    matmul_dtype: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        # Lazy like _PallasCore: XLA-only consumers never pay (or
+        # depend on) the Pallas TPU imports.
+        from scalable_agent_tpu.ops import conv_pallas
+
+        c = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.kernel_size, self.kernel_size, c, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        x, k, b = (jnp.asarray(t, self.dtype) for t in (x, kernel, bias))
+        matmul_dtype = self.matmul_dtype or (
+            "bfloat16" if jnp.dtype(self.dtype) == jnp.dtype(jnp.bfloat16)
+            else "float32")
+        out = conv_pallas.stem_conv(
+            x, k, self.stride, jax.default_backend() != "tpu",
+            matmul_dtype)
+        return out + b
+
+
+def _stem_backend(conv_backend):
+    if conv_backend not in CONV_BACKENDS:
+        raise ValueError(
+            f"unknown conv_backend: {conv_backend!r} "
+            f"(choices: {CONV_BACKENDS})")
+    return conv_backend == "pallas"
+
+
 class ShallowConvTorso(nn.Module):
     """(32,8,4), (64,4,2), (128,3,2) conv stack + Dense(256).
 
     Input [N, H, W, C] uint8; output [N, 256] float32.
     (reference: experiment.py:178-189)
 
-    ``space_to_depth`` computes the stem conv in its space-to-depth
-    form — same parameters, same linear map.  Default OFF: measured
-    SLOWER for this torso, whose stem input needs no gradient (see
-    _SpaceToDepthFirstConv for the measurement story).
+    ``conv_backend`` ("xla" | "pallas") picks the stem conv's grad-W
+    lowering (see CONV_BACKENDS); ``space_to_depth`` computes the stem
+    conv in its space-to-depth form — same parameters, same linear
+    map.  Default OFF: measured SLOWER for this torso, whose stem
+    input needs no gradient (see _SpaceToDepthFirstConv for the
+    measurement story).  Output dtype is ``dtype`` — the caller owns
+    any upcast (the agent's heads return f32 logits/baseline).
     """
 
     dtype: Any = jnp.float32
     space_to_depth: bool = False
+    conv_backend: str = "xla"
 
     @nn.compact
     def __call__(self, frame):
+        pallas_stem = _stem_backend(self.conv_backend)
         x = _normalize_frame(frame, self.dtype)
         for i, (num_ch, filter_size, stride) in enumerate(
                 [(32, 8, 4), (64, 4, 2), (128, 3, 2)]):
-            if i == 0 and self.space_to_depth:
+            if i == 0 and pallas_stem:
+                x = PallasStemConv(
+                    num_ch, filter_size, stride, dtype=self.dtype,
+                    name="conv_0")(x)
+            elif i == 0 and self.space_to_depth:
                 x = _SpaceToDepthFirstConv(
                     num_ch, dtype=self.dtype, name="conv_0")(x)
             else:
@@ -125,7 +198,13 @@ class ShallowConvTorso(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(256, dtype=self.dtype, name="fc")(x)
         x = nn.relu(x)
-        return jnp.asarray(x, jnp.float32)
+        # The torso stays in its compute dtype end-to-end: under a
+        # bfloat16 policy the downstream concat/core/head matmuls are
+        # the point of the policy, and the agent upcasts its OUTPUTS
+        # (logits/baseline) to f32 for the loss.  asarray is an
+        # identity under the f32 default, so the golden-loss anchor
+        # (tests/test_replay.py) is untouched.
+        return jnp.asarray(x, self.dtype)
 
 
 class _ResidualBlock(nn.Module):
@@ -147,18 +226,30 @@ class _ResidualBlock(nn.Module):
 class ResNetTorso(nn.Module):
     """Deep IMPALA ResNet: sections (16, 32, 32) x 2 residual blocks.
 
-    Input [N, H, W, C] uint8; output [N, 256] float32.
+    Input [N, H, W, C] uint8; output [N, 256] in ``dtype`` (the agent
+    owns the f32 upcast of its outputs — see ShallowConvTorso).
     (reference: experiment.py:156-176, commented-out variant)
+
+    ``conv_backend="pallas"`` routes the stem (``downscale_0`` — like
+    the shallow torso's conv_0, its input is the gradient-free frame)
+    through the Pallas grad-W kernel; 3x3/stride-1 satisfies the
+    kernel's K % S == 0 layout, so both torsos honor the one flag.
     """
 
     dtype: Any = jnp.float32
+    conv_backend: str = "xla"
 
     @nn.compact
     def __call__(self, frame):
+        pallas_stem = _stem_backend(self.conv_backend)
         x = _normalize_frame(frame, self.dtype)
         for i, (num_ch, num_blocks) in enumerate([(16, 2), (32, 2), (32, 2)]):
-            x = nn.Conv(num_ch, (3, 3), padding="SAME", dtype=self.dtype,
-                        name=f"downscale_{i}")(x)
+            if i == 0 and pallas_stem:
+                x = PallasStemConv(num_ch, 3, 1, dtype=self.dtype,
+                                   name="downscale_0")(x)
+            else:
+                x = nn.Conv(num_ch, (3, 3), padding="SAME",
+                            dtype=self.dtype, name=f"downscale_{i}")(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
             for j in range(num_blocks):
                 x = _ResidualBlock(num_ch, dtype=self.dtype,
@@ -167,7 +258,7 @@ class ResNetTorso(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(256, dtype=self.dtype, name="fc")(x)
         x = nn.relu(x)
-        return jnp.asarray(x, jnp.float32)
+        return jnp.asarray(x, self.dtype)
 
 
 TORSOS = {

@@ -21,8 +21,11 @@ gauge, and this ledger).
 Per-kernel FLOPs come from a mini HLO cost model (``parse_hlo_kernel_
 costs``): dots count ``2·prod(result)·K`` from the contracting dims,
 convolutions ``2·out_elems·kernel_elems/out_features`` from
-``dim_labels``, fusions sum their called computation, elementwise ops
-count one flop per result element.  The raw estimates are then
+``dim_labels``, fusions sum their called computation, named Pallas
+custom-calls get explicit per-kernel cost entries (XLA cannot see
+inside a ``pallas_call``, and the elementwise floor would misprice an
+MXU matmul kernel by ~3 orders of magnitude), elementwise ops count
+one flop per result element.  The raw estimates are then
 NORMALIZED so the matched kernels' per-update FLOPs sum exactly to the
 XLA cost-analysis total — XLA's aggregate is authoritative (it is the
 MFU numerator), the HLO parse distributes it across kernels.  Both the
@@ -186,12 +189,73 @@ def _bytes(shapes: List[Tuple[int, List[int]]]) -> int:
     return sum(b * math.prod(dims) for b, dims in shapes)
 
 
+# -- Pallas custom-call costs ------------------------------------------------
+# A ``pallas_call`` lowers to a ``custom-call`` whose body XLA cannot
+# see, so the generic model would fall through to the one-flop-per-
+# element floor — mispricing an MXU matmul kernel by orders of
+# magnitude and hiding it from the worst-kernel verdict.  Named Pallas
+# kernels therefore get explicit cost entries, keyed on the kernel name
+# the op stamps into its instruction metadata (both the named_scope
+# breadcrumb in ``op_name`` and the pallas_call ``name=`` carry it).
+# The name strings are a CONTRACT with ops/* (this module stays
+# jax-free, so it cannot import them); tests/test_kernel_ledger.py pins
+# that the two sides agree.
+
+# ops/conv_pallas.py GRADW_KERNEL_NAME.
+_PALLAS_GRADW_MARKER = "pallas_conv0_gradw"
+
+
+def _pallas_gradw_flops(result: List, operands: List) -> Optional[float]:
+    """ops/conv_pallas.py grad-W: an im2col matmul contracting every
+    output position of the upstream gradient ``g=[N,OH,OW,F]`` against
+    the patch matrix into dW rows ``[K*K*Cin, F]``:
+    ``2 * N*OH*OW * rows * F``.  The g operand is recognized among the
+    custom-call's inputs as the 4-d tensor whose trailing dim matches
+    the result's feature dim (the patch operand's trailing dim is the
+    im2col depth ``S*S*Cin`` instead)."""
+    if not result or not operands:
+        return None
+    out_dims = result[0][1]
+    if len(out_dims) != 2:
+        return None
+    rows, features = out_dims
+    g_dims = next((dims for _, dims in operands
+                   if len(dims) == 4 and dims[-1] == features), None)
+    if g_dims is None:
+        return None
+    return 2.0 * math.prod(g_dims[:3]) * rows * features
+
+
+_PALLAS_KERNEL_COSTS = (
+    (_PALLAS_GRADW_MARKER, _pallas_gradw_flops),
+)
+
+
+def _custom_call_flops(result: List, operands: List,
+                       attrs: str) -> Optional[float]:
+    """Explicit cost for a recognized named Pallas custom-call, or None
+    to fall through to the elementwise floor.  The marker is searched in
+    the whole attr text: TPU lowers pallas_call to ``custom-call
+    ... custom_call_target="tpu_custom_call"`` with the kernel name in
+    the metadata ``op_name`` scope path and/or backend config."""
+    for marker, cost_fn in _PALLAS_KERNEL_COSTS:
+        if marker in attrs:
+            flops = cost_fn(result, operands)
+            if flops is not None:
+                return flops
+    return None
+
+
 def _instruction_flops(op: str, result: List, operands: List,
                        attrs: str, called_flops: Optional[float]) -> float:
     """The mini cost model, per execution of one instruction."""
     if op in _ZERO_FLOP_OPS:
         return 0.0
     out_elems = _elems(result)
+    if op == "custom-call":
+        flops = _custom_call_flops(result, operands, attrs)
+        if flops is not None:
+            return flops
     if op == "dot":
         m = _LHS_CONTRACT_RE.search(attrs)
         if m and operands:
@@ -219,8 +283,9 @@ def _instruction_flops(op: str, result: List, operands: List,
     if op in ("reduce", "reduce-window", "reduce-scatter", "all-reduce",
               "select-and-scatter", "sort", "cumsum"):
         return float(_elems(operands) or out_elems)
-    # Elementwise / transcendental / comparison / rng / custom-call
-    # fallback: one flop per result element — a floor, not a claim.
+    # Elementwise / transcendental / comparison / rng / unrecognized-
+    # custom-call fallback: one flop per result element — a floor, not
+    # a claim.
     return float(out_elems)
 
 

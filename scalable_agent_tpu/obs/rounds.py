@@ -519,6 +519,11 @@ TRAJECTORY_METRICS: Tuple[Tuple[str, str, str], ...] = (
     ("learning_rho_clip_fraction", "V-trace rho clip fraction", "frac"),
     ("learning_ess_frac", "importance-weight ESS", "frac"),
     ("learning_entropy_frac", "policy entropy (normalized)", "frac"),
+    ("conv0_gradw_pallas_mfu", "pallas stem grad-W MFU", "frac"),
+    ("update_f32_fps", "kernel-war f32 update fps", "fps"),
+    ("update_bf16_fps", "kernel-war bf16 update fps", "fps"),
+    ("fused_forward_sec_per_update", "fused-loss sec/update", "s"),
+    ("double_forward_sec_per_update", "double-forward sec/update", "s"),
 )
 
 

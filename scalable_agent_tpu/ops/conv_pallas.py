@@ -1,0 +1,215 @@
+"""Pallas weight-gradient kernel for the torso's strided stem conv.
+
+The per-kernel roofline ledger names ``conv0_gradw`` as the learner's
+worst kernel: XLA lowers the 8x8/stride-4 stem's weight gradient to a
+kernel that runs at 0.107 MFU for ~13 ms at the B=256 merged batch
+(BENCH_NOTES round-5 conv table), and the space-to-depth reformulation
+made it WORSE (0.047) because it only helps the input gradient — which
+the stem, fed by the gradient-free uint8 frame, never computes.  This
+module attacks the weight gradient directly.
+
+``stem_conv`` is the SAME 8x8/stride-4 convolution wrapped in a
+``jax.custom_vjp``:
+
+- **forward** and **grad-input** stay XLA's (both already run near the
+  layer's output-lane ceiling; grad-input is DCE'd entirely in the
+  torso, whose stem input needs no gradient),
+- **grad-W** is a Pallas im2col-tiled MXU matmul.  The padded input is
+  re-laid-out once (space-to-depth by the stride S, so every kernel tap
+  becomes a CONTIGUOUS slice), then a sequential grid over the batch
+  gathers per-tile patch matrices ``P [BN*OH*OW, K*K*Cin]`` from D*D
+  static slices (D = K/S), contracts them against the output cotangent
+  ``G [BN*OH*OW, Cout]`` on the MXU, and accumulates ``[K*K*Cin, Cout]``
+  in float32 VMEM scratch across grid steps — one revisited
+  constant-index output block, exactly the lstm_pallas.py accumulation
+  idiom.
+
+Why this beats XLA's lowering: XLA derives grad-W as a conv with the
+8x8 kernel dims mapped to the *spatial output* of a big dilated
+convolution — a shape (8x8 "image", 32 lanes) that strands most of the
+MXU.  Here the contraction is a single [K*K*Cin, N*OH*OW] x
+[N*OH*OW, Cout] matmul with the huge merged batch as the contracting
+dimension, which is the shape the MXU was built for.
+
+Requires ``K % S == 0`` (true for the 8/4 stem; D = K/S).  Any other
+kernel/stride pair silently falls back to XLA's own grad-W — the
+wrapper is then semantically inert, and the parity tests pin that.
+
+Like ops/lstm_pallas.py: ``interpret=True`` runs the identical kernel
+under the Pallas interpreter so CPU tier-1 exercises the same code
+path, and ``matmul_dtype`` picks the MXU operand precision ("float32"
+bit-parity / "bfloat16" 2x rate, f32 accumulation either way via
+``preferred_element_type``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Trace/HLO name of the grad-W kernel.  obs/kernels.py keys its
+# custom-call FLOPs model on this exact string appearing in the
+# instruction's op_name metadata — change them together.
+GRADW_KERNEL_NAME = "pallas_conv0_gradw"
+
+# VMEM budget for one grid tile's working set (inputs + patch matrix);
+# the batch tile BN shrinks to fit.  Conservative: ~half of a v5e
+# core's 16 MB, leaving room for the pipeline's double buffering.
+_TILE_BYTES_BUDGET = 8 << 20
+_MAX_BATCH_TILE = 32
+
+
+def _resolve_matmul_dtype(matmul_dtype):
+    dtype = jnp.dtype(matmul_dtype)
+    if dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"matmul_dtype must be float32 or bfloat16, got {dtype}")
+    return dtype
+
+
+def _forward(x, w, stride):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _same_pads(size, k, s):
+    """XLA SAME padding: out = ceil(size/s); lo gets the smaller half."""
+    out = -(-size // s)
+    total = max((out - 1) * s + k - size, 0)
+    return out, (total // 2, total - total // 2)
+
+
+def _gradw_kernel(xs_ref, g_ref, dw_ref, acc_s, *, depth, out_h, out_w,
+                  matmul_dtype):
+    """One batch tile of the grad-W contraction.
+
+    xs_ref [BN, OH+D-1, OW+D-1, S*S*C] — space-to-depth input; each
+    kernel tap (dh, dw) of the ORIGINAL conv is the contiguous slice
+    ``xs[:, dh:dh+OH, dw:dw+OW, :]``.  g_ref [BN, OH, OW, F] is the
+    output cotangent.  Accumulates [D*D*S*S*C, F] in f32 scratch; the
+    constant-index dw_ref block is written every step (last survives).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    bn = xs_ref.shape[0]
+    s2c = xs_ref.shape[-1]
+    f = g_ref.shape[-1]
+    rows = bn * out_h * out_w
+    patches = [
+        xs_ref[:, dh:dh + out_h, dw:dw + out_w, :].reshape(rows, s2c)
+        for dh in range(depth) for dw in range(depth)
+    ]
+    p = jnp.concatenate(patches, axis=-1).astype(matmul_dtype)
+    g = g_ref[...].reshape(rows, f).astype(matmul_dtype)
+    # [D*D*S*S*C, BN*OH*OW] x [BN*OH*OW, F]: the merged batch is the
+    # contracting dim — the MXU-shaped form of grad-W.
+    acc_s[...] += lax.dot_general(
+        p, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dw_ref[...] = acc_s[...]
+
+
+def _batch_tile(n, per_image_floats):
+    bn = max(1, _TILE_BYTES_BUDGET // max(1, per_image_floats * 4))
+    return max(1, min(n, _MAX_BATCH_TILE, bn))
+
+
+def conv_gradw(x, g, kernel_size, stride, interpret=False,
+               matmul_dtype="float32"):
+    """Weight gradient of the SAME-padded ``kernel_size``/``stride``
+    conv: x [N,H,W,C], g [N,OH,OW,F] -> dW [K,K,C,F] float32.  Pallas
+    when ``kernel_size % stride == 0``, XLA's own grad-W otherwise."""
+    matmul_dtype = _resolve_matmul_dtype(matmul_dtype)
+    n, h, w_in, c = x.shape
+    _, out_h, out_w, f = g.shape
+    k, s = int(kernel_size), int(stride)
+    if k % s != 0:
+        # The D-slice gather needs every tap on the s2d lattice; other
+        # geometries take XLA's derivative (already fine off the stem).
+        w_shape = (k, k, c, f)
+        _, vjp_w = jax.vjp(
+            lambda ww: _forward(x, ww, s),
+            jnp.zeros(w_shape, x.dtype))
+        return vjp_w(g)[0].astype(jnp.float32)
+
+    depth = k // s
+    _, (ph_lo, ph_hi) = _same_pads(h, k, s)
+    _, (pw_lo, pw_hi) = _same_pads(w_in, k, s)
+    xp = jnp.pad(x, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+    hp, wp = xp.shape[1], xp.shape[2]
+    # Space-to-depth by the stride: [N, HP/S, WP/S, S*S*C], depth rows
+    # ordered (sh, sw, c).  HP = (OH-1)*S + K = (OH+D-1)*S exactly, so
+    # the lattice always divides.
+    xs = xp.reshape(n, hp // s, s, wp // s, s, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, hp // s, wp // s, s * s * c)
+    tile_h, tile_w = out_h + depth - 1, out_w + depth - 1
+    s2c = s * s * c
+    per_image = (tile_h * tile_w * s2c + out_h * out_w * f
+                 + out_h * out_w * depth * depth * s2c)
+    bn = _batch_tile(n, per_image)
+    n_pad = -(-n // bn) * bn
+    if n_pad != n:
+        # Zero-padded images contribute zero cotangent rows — exact.
+        xs = jnp.pad(xs, ((0, n_pad - n), (0, 0), (0, 0), (0, 0)))
+        g = jnp.pad(g, ((0, n_pad - n), (0, 0), (0, 0), (0, 0)))
+    rows_out = depth * depth * s2c
+    with jax.named_scope(GRADW_KERNEL_NAME):
+        dw = pl.pallas_call(
+            functools.partial(
+                _gradw_kernel, depth=depth, out_h=out_h, out_w=out_w,
+                matmul_dtype=matmul_dtype),
+            grid=(n_pad // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, tile_h, tile_w, s2c),
+                             lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((bn, out_h, out_w, f),
+                             lambda i: (i, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows_out, f), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows_out, f), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((rows_out, f), jnp.float32)],
+            interpret=interpret,
+            name=GRADW_KERNEL_NAME,
+        )(xs, g)
+    # Rows are ordered (dh, dw, sh, sw, c); kh = dh*S + sh.
+    dw = dw.reshape(depth, depth, s, s, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return dw.reshape(k, k, c, f)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def stem_conv(x, w, stride=4, interpret=False, matmul_dtype="float32"):
+    """SAME-padded NHWC conv (x [N,H,W,C], w [K,K,C,F], square stride)
+    whose weight gradient is the Pallas im2col kernel above.  Forward
+    and input gradient are XLA's — numerically this op IS
+    ``lax.conv_general_dilated(..., "SAME")``; only d/dW's lowering
+    differs.  ``interpret`` and ``matmul_dtype`` follow
+    ops/lstm_pallas.py's contract."""
+    return _forward(x, w, stride)
+
+
+def _vjp_fwd(x, w, stride, interpret, matmul_dtype):
+    return _forward(x, w, stride), (x, w)
+
+
+def _vjp_bwd(stride, interpret, matmul_dtype, residuals, g):
+    x, w = residuals
+    # Input gradient: XLA's transposed conv.  In the torso the stem's
+    # input is the gradient-free normalized frame, so this whole branch
+    # is dead code XLA eliminates; it exists for standalone parity.
+    _, vjp_x = jax.vjp(lambda xx: _forward(xx, w, stride), x)
+    dx = vjp_x(g)[0]
+    dw = conv_gradw(x, g, w.shape[0], stride, interpret=interpret,
+                    matmul_dtype=matmul_dtype)
+    return dx, dw.astype(w.dtype)
+
+
+stem_conv.defvjp(_vjp_fwd, _vjp_bwd)

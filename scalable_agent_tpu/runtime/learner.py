@@ -299,8 +299,20 @@ class Learner:
         loss: str = "vtrace",
         target_update_interval: int = 100,
         impact_clip_epsilon: float = 0.3,
+        fused_forward: bool = True,
     ):
         self._agent = agent
+        # Fused single-forward loss (default): ONE whole-trajectory
+        # unroll (Learner._forward) produces both the
+        # behaviour-comparison quantities V-trace consumes (target
+        # logits, values, bootstrap) and the differentiated loss
+        # outputs.  ``False`` compiles the two-pass REFERENCE shape —
+        # a separate stop-gradiented comparison unroll behind an
+        # optimization barrier (so XLA cannot CSE it back into one) —
+        # kept as bench_kernel_war's measurable baseline, not for
+        # production.  Both compile to the same loss value and
+        # gradient: V-trace stop-gradients every input (ops/vtrace.py).
+        self._fused_forward = bool(fused_forward)
         self._hp = hp
         self._mesh = mesh
         self._frames_per_update = float(frames_per_update)
@@ -632,6 +644,53 @@ class Learner:
 
     # -- update -----------------------------------------------------------
 
+    def _forward(self, params, trajectory: Trajectory, capture=False):
+        """The ONE whole-trajectory unroll of the update (reference:
+        experiment.py:358-365).  Every loss quantity — the
+        behaviour-comparison logits V-trace consumes AND the
+        differentiated policy/value outputs — derives from this single
+        apply; tests/test_learner_fused.py counts the lowered convs to
+        pin it.  ``capture=True`` additionally captures the torso
+        output (flax capture_intermediates) for the dead-unit gauge —
+        still no second forward.  Returns ``((logits [T+1,B,L] f32,
+        baselines [T+1,B] f32), dead_torso_frac | None)``."""
+        if capture:
+            (out, _), captured = self._agent.apply(
+                params,
+                trajectory.agent_outputs.action,
+                trajectory.env_outputs,
+                trajectory.agent_state,
+                capture_intermediates=_torso_filter,
+                mutable=["intermediates"],
+            )
+            return out, _dead_unit_fraction(captured)
+        out, _ = self._agent.apply(
+            params,
+            trajectory.agent_outputs.action,
+            trajectory.env_outputs,
+            trajectory.agent_state,
+        )
+        return out, None
+
+    def _comparison_forward(self, params, trajectory: Trajectory):
+        """The UNFUSED (``fused_forward=False``) reference: a separate
+        stop-gradiented unroll for the comparison quantities V-trace
+        reads.  The optimization barrier keeps XLA from CSE-ing this
+        pass back into the differentiated one (the two forwards are
+        value-identical by construction, so without the barrier the
+        'double forward' baseline would silently measure the fused
+        program).  Exists to keep the single-vs-double-forward delta
+        measurable (bench_kernel_war); production always fuses.
+        ``stop_gradient`` BEFORE the barrier: optimization_barrier has
+        no differentiation rule, and the comparison pass never needs
+        one (its outputs are stop-gradiented anyway); stop_gradient is
+        identity in lowered HLO, so the anti-CSE barrier survives."""
+        barrier_params = jax.lax.optimization_barrier(
+            jax.lax.stop_gradient(params))
+        (logits, baselines), _ = self._forward(barrier_params, trajectory)
+        return (jax.lax.stop_gradient(logits),
+                jax.lax.stop_gradient(baselines))
+
     def _loss(self, params, trajectory: Trajectory, target_params=None):
         """Dispatch on the construction-time surrogate choice (a Python
         branch: each jit specialization compiles exactly one)."""
@@ -641,40 +700,27 @@ class Learner:
 
     def _loss_vtrace(self, params, trajectory: Trajectory):
         hp = self._hp
-        # Target-policy unroll over the whole T+1 window (reference:
-        # experiment.py:358-365).  With the learning-dynamics plane on,
-        # the same unroll also captures the torso output (flax
-        # capture_intermediates) for the dead-unit gauge — no second
-        # forward.
-        dead_torso = None
-        if self._learn_enabled:
-            ((target_logits, baselines), _), captured = self._agent.apply(
-                params,
-                trajectory.agent_outputs.action,
-                trajectory.env_outputs,
-                trajectory.agent_state,
-                capture_intermediates=_torso_filter,
-                mutable=["intermediates"],
-            )
-            dead_torso = _dead_unit_fraction(captured)
+        (target_logits, baselines), dead_torso = self._forward(
+            params, trajectory, capture=self._learn_enabled)
+        if self._fused_forward:
+            comparison_logits, comparison_baselines = (
+                target_logits, baselines)
         else:
-            (target_logits, baselines), _ = self._agent.apply(
-                params,
-                trajectory.agent_outputs.action,
-                trajectory.env_outputs,
-                trajectory.agent_state,
-            )
+            comparison_logits, comparison_baselines = (
+                self._comparison_forward(params, trajectory))
         # The last baseline is the bootstrap; then drop the last target
         # output and the first behaviour/env entry (reference:
         # experiment.py:368-375 — "use last baseline value for
         # bootstrapping").
-        bootstrap_value = baselines[-1]
+        bootstrap_value = comparison_baselines[-1]
         behaviour = jax.tree_util.tree_map(
             lambda t: t[1:], trajectory.agent_outputs)
         env_outputs = jax.tree_util.tree_map(
             lambda t: t[1:], trajectory.env_outputs)
         target_logits = target_logits[:-1]
         baselines = baselines[:-1]
+        comparison_logits = comparison_logits[:-1]
+        comparison_baselines = comparison_baselines[:-1]
 
         rewards = losses_lib.clip_rewards(
             env_outputs.reward, hp.reward_clipping)
@@ -682,13 +728,16 @@ class Learner:
             env_outputs.done, 0.0, hp.discounting).astype(jnp.float32)
 
         dist_spec = self._agent.dist_spec
+        # V-trace reads the COMPARISON quantities (identical tensors in
+        # the fused path; V-trace stop-gradients internally, so the
+        # unfused reference matches it bit-for-bit)...
         vt = vtrace.from_logits(
             behaviour_policy_logits=behaviour.policy_logits,
-            target_policy_logits=target_logits,
+            target_policy_logits=comparison_logits,
             actions=behaviour.action,
             discounts=discounts,
             rewards=rewards,
-            values=baselines,
+            values=comparison_baselines,
             bootstrap_value=bootstrap_value,
             clip_rho_threshold=hp.clip_rho_threshold,
             clip_pg_rho_threshold=hp.clip_pg_rho_threshold,
@@ -697,6 +746,7 @@ class Learner:
             mesh=self._mesh if self._scan_impl == "time_sharded" else None,
         )
 
+        # ...while the DIFFERENTIATED outputs feed the loss terms.
         pg_loss = losses_lib.compute_policy_gradient_loss(
             target_logits, behaviour.action, vt.pg_advantages,
             dist_spec=dist_spec)
@@ -726,36 +776,21 @@ class Learner:
         π_θ against π_tgt.  Baseline/entropy terms keep the vtrace
         branch's shape so the cost hyperparameters transfer."""
         hp = self._hp
-        dead_torso = None
-        if self._learn_enabled:
-            # Capture the ONLINE net's torso output (the params being
-            # optimized) for the dead-unit gauge.
-            ((online_logits, baselines), _), captured = self._agent.apply(
-                params,
-                trajectory.agent_outputs.action,
-                trajectory.env_outputs,
-                trajectory.agent_state,
-                capture_intermediates=_torso_filter,
-                mutable=["intermediates"],
-            )
-            dead_torso = _dead_unit_fraction(captured)
+        # ONE online unroll (capture feeds the dead-unit gauge — the
+        # params being optimized).
+        (online_logits, baselines), dead_torso = self._forward(
+            params, trajectory, capture=self._learn_enabled)
+        if self._fused_forward:
+            comparison_baselines = baselines
         else:
-            (online_logits, baselines), _ = self._agent.apply(
-                params,
-                trajectory.agent_outputs.action,
-                trajectory.env_outputs,
-                trajectory.agent_state,
-            )
-        # Second (target-net) unroll: the staleness anchor.  Costs one
-        # extra forward — the price of tolerating arbitrarily stale
-        # behaviour data.
-        (anchor_logits, _), _ = self._agent.apply(
-            target_params,
-            trajectory.agent_outputs.action,
-            trajectory.env_outputs,
-            trajectory.agent_state,
-        )
-        bootstrap_value = baselines[-1]
+            _, comparison_baselines = self._comparison_forward(
+                params, trajectory)
+        # Second (TARGET-net) unroll: the staleness anchor.  This one
+        # is irreducible — different params — and is the price of
+        # tolerating arbitrarily stale behaviour data; the fused-
+        # forward contract is about the ONLINE net only.
+        (anchor_logits, _), _ = self._forward(target_params, trajectory)
+        bootstrap_value = comparison_baselines[-1]
         behaviour = jax.tree_util.tree_map(
             lambda t: t[1:], trajectory.agent_outputs)
         env_outputs = jax.tree_util.tree_map(
@@ -763,6 +798,7 @@ class Learner:
         online_logits = online_logits[:-1]
         anchor_logits = anchor_logits[:-1]
         baselines = baselines[:-1]
+        comparison_baselines = comparison_baselines[:-1]
 
         rewards = losses_lib.clip_rewards(
             env_outputs.reward, hp.reward_clipping)
@@ -776,7 +812,7 @@ class Learner:
             actions=behaviour.action,
             discounts=discounts,
             rewards=rewards,
-            values=baselines,
+            values=comparison_baselines,
             bootstrap_value=bootstrap_value,
             clip_rho_threshold=hp.clip_rho_threshold,
             clip_pg_rho_threshold=hp.clip_pg_rho_threshold,

@@ -825,6 +825,91 @@ class TestKernelRegressionGuard:
                     if "KERNEL REGRESSION" in e]
 
 
+class TestKernelWarGuard:
+    """ISSUE 18: the three kernel-war wins — Pallas grad-W >= 3x the
+    XLA stem MFU, bf16 update >= 1.3x f32 fps, fused loss >= 1.15x the
+    double-forward program — bind on TPU, warn on the CPU fallback,
+    and a key published last round must never silently vanish."""
+
+    def test_pallas_mfu_below_3x_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_mfu": 0.107,
+                "conv0_gradw_pallas_mfu": 0.2}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert any("KERNEL WAR" in e and "grad-W" in e
+                   for e in diag["errors"])
+
+    def test_compares_against_measured_xla_mfu_when_present(
+            self, tmp_path):
+        """A same-round bench_convs measurement beats the pinned r05
+        constant: pallas at 0.34 clears 3x the 0.107 constant but NOT
+        3x a measured 0.15 — the guard must use the measurement."""
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_mfu": 0.15,
+                "conv0_gradw_pallas_mfu": 0.34}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert any("KERNEL WAR" in e for e in diag["errors"])
+
+    def test_bf16_below_floor_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "update_f32_fps": 100.0, "update_bf16_fps": 110.0}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert any("KERNEL WAR" in e and "bf16" in e
+                   for e in diag["errors"])
+
+    def test_fused_below_floor_fails_on_tpu(self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "fused_forward_sec_per_update": 1.0,
+                "double_forward_sec_per_update": 1.05}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert any("KERNEL WAR" in e and "fused" in e
+                   for e in diag["errors"])
+
+    def test_breaches_are_advisory_on_cpu_fallback(self, tmp_path):
+        diag = {"errors": [], "platform": "cpu",
+                "update_f32_fps": 100.0, "update_bf16_fps": 50.0,
+                "fused_forward_sec_per_update": 1.0,
+                "double_forward_sec_per_update": 1.0}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == []
+        assert len(diag["warnings"]) == 2
+
+    def test_healthy_round_is_silent_and_records_speedup(
+            self, tmp_path):
+        diag = {"errors": [], "platform": "tpu",
+                "kernel_conv0_gradw_mfu": 0.107,
+                "conv0_gradw_pallas_mfu": 0.45,
+                "update_f32_fps": 100.0, "update_bf16_fps": 140.0,
+                "fused_forward_sec_per_update": 1.0,
+                "double_forward_sec_per_update": 1.2}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+        assert diag["conv0_gradw_pallas_speedup"] == pytest.approx(
+            4.21, abs=0.01)
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        (tmp_path / "BENCH_r09.json").write_text(__import__("json").dumps(
+            {"metric": "m", "platform": "tpu",
+             "update_bf16_fps": 140.0}))
+        diag = {"errors": [], "platform": "tpu"}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert any("KERNEL WAR" in e and "missing" in e
+                   for e in diag["errors"])
+
+    def test_stage_never_ran_anywhere_is_silent(self, tmp_path):
+        """No keys this round AND no prior artifact claiming them (the
+        CPU tier before any TPU round): nothing to enforce."""
+        diag = {"errors": [], "platform": "cpu"}
+        bench.kernel_war_guard(diag, bench_dir=str(tmp_path))
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_runs_against_real_committed_artifacts(self):
+        diag = {"errors": [], "platform": "cpu"}
+        bench.kernel_war_guard(diag)
+        assert not [e for e in diag["errors"] if "KERNEL WAR" in e]
+
+
 class TestGuardRegistry:
     """ISSUE 14 unification: the ~12 regression guards live on ONE
     registry with one binding-vs-advisory policy table and a single
@@ -837,6 +922,10 @@ class TestGuardRegistry:
                      if callable(obj)
                      and name.endswith("_regression_guard")}
         functions.add("regression_guard")
+        # Floor guards (absolute acceptance thresholds, not artifact
+        # regressions) don't carry the suffix but must be registered
+        # all the same.
+        functions.add("kernel_war_guard")
         assert {spec.name for spec in bench.GUARD_REGISTRY} == functions
 
     def test_every_policy_is_in_the_table(self):

@@ -1,0 +1,180 @@
+"""Pallas grad-W stem kernel (ops/conv_pallas.py): parity against
+XLA's own derivative across geometry edges, the K % S fallback, the
+bf16 MXU-operand mode, batch-tile padding, and checkpoint
+interchangeability of the agent-facing PallasStemConv module.
+
+All CPU runs go through the Pallas interpreter (the same kernel body
+TPU compiles), so tier-1 exercises the real code path — the
+ops/lstm_pallas.py testing contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.ops import conv_pallas
+
+_INTERPRET = jax.default_backend() != "tpu"
+
+
+def _conv(x, w, s):
+    return jax.lax.conv_general_dilated(
+        x, w, (s, s), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _reference_gradw(x, cot, k, s):
+    """XLA's own d/dW of the SAME conv under cotangent ``cot`` — the
+    derivative the Pallas kernel must reproduce."""
+    w0 = jnp.zeros((k, k, x.shape[-1], cot.shape[-1]), jnp.float32)
+    return jax.grad(lambda w: jnp.sum(_conv(x, w, s) * cot))(w0)
+
+
+def _random_case(seed, n, h, w, c, f, s):
+    kx, kg = jax.random.split(jax.random.key(seed))
+    out_h, out_w = -(-h // s), -(-w // s)
+    x = jax.random.normal(kx, (n, h, w, c), jnp.float32)
+    g = jax.random.normal(kg, (n, out_h, out_w, f), jnp.float32)
+    return x, g
+
+
+# (h, w, k, s): the stem aspect at reduced size, odd spatial extents
+# (asymmetric SAME padding on both axes), a smaller stem, stride ==
+# kernel (depth-1 tiles, no overlap), and the 1x1 degenerate case.
+GEOMETRIES = (
+    (24, 32, 8, 4),
+    (17, 23, 8, 4),
+    (9, 11, 4, 2),
+    (8, 8, 2, 2),
+    (5, 5, 1, 1),
+)
+
+
+class TestGradWParity:
+    @pytest.mark.parametrize("h,w,k,s", GEOMETRIES)
+    def test_f32_matches_xla_derivative(self, h, w, k, s):
+        x, g = _random_case(k * 100 + s, 3, h, w, 3, 8, s)
+        dw = conv_pallas.conv_gradw(x, g, k, s, interpret=_INTERPRET)
+        ref = _reference_gradw(x, g, k, s)
+        assert dw.dtype == jnp.float32
+        np.testing.assert_allclose(dw, ref, rtol=2e-5, atol=2e-5)
+
+    def test_bf16_operands_f32_accumulation(self):
+        """bf16 MXU operands with the f32 scratch accumulator: the
+        documented tolerance is bf16's ~8-bit mantissa on the operands,
+        NOT a bf16 accumulation error (which would grow with N*OH*OW
+        and blow far past 3e-2 at this size)."""
+        x, g = _random_case(7, 4, 24, 32, 3, 8, 4)
+        dw = conv_pallas.conv_gradw(x, g, 8, 4, interpret=_INTERPRET,
+                                    matmul_dtype="bfloat16")
+        ref = _reference_gradw(x, g, 8, 4)
+        assert dw.dtype == jnp.float32
+        scale = float(jnp.max(jnp.abs(ref)))
+        np.testing.assert_allclose(dw, ref, rtol=3e-2,
+                                   atol=3e-2 * scale)
+
+    def test_k_not_multiple_of_stride_falls_back_exact(self):
+        """K % S != 0 breaks the space-to-depth tap lattice, so the op
+        routes to XLA's own derivative — bit-identical by
+        construction."""
+        x, g = _random_case(11, 3, 10, 13, 3, 8, 2)
+        dw = conv_pallas.conv_gradw(x, g, 3, 2, interpret=_INTERPRET)
+        ref = _reference_gradw(x, g, 3, 2)
+        np.testing.assert_array_equal(np.asarray(dw), np.asarray(ref))
+
+    def test_batch_tile_padding_remainder(self, monkeypatch):
+        """N not divisible by the batch tile zero-pads the grid's last
+        step; zero cotangent rows contribute exactly zero, so the
+        result must not change vs the untiled answer."""
+        monkeypatch.setattr(conv_pallas, "_MAX_BATCH_TILE", 2)
+        x, g = _random_case(13, 5, 16, 16, 3, 8, 4)
+        dw = conv_pallas.conv_gradw(x, g, 8, 4, interpret=_INTERPRET)
+        ref = _reference_gradw(x, g, 8, 4)
+        np.testing.assert_allclose(dw, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestStemConvVjp:
+    def test_forward_is_xla_conv(self):
+        x, _ = _random_case(17, 2, 17, 23, 3, 8, 4)
+        w = jax.random.normal(jax.random.key(3), (8, 8, 3, 8),
+                              jnp.float32) * 0.05
+        out = conv_pallas.stem_conv(x, w, 4, _INTERPRET, "float32")
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(_conv(x, w, 4)))
+
+    def test_value_and_grad_under_jit(self):
+        """The full custom_vjp in a jitted value_and_grad over BOTH
+        inputs: dx is XLA's transposed conv (exact), dw the Pallas
+        kernel (tight f32 tolerance)."""
+        x, _ = _random_case(19, 2, 16, 16, 3, 8, 4)
+        w = jax.random.normal(jax.random.key(5), (8, 8, 3, 8),
+                              jnp.float32) * 0.05
+
+        def loss(op):
+            return lambda xx, ww: jnp.sum(op(xx, ww) ** 2)
+
+        pallas_loss = jax.jit(jax.value_and_grad(
+            loss(lambda xx, ww: conv_pallas.stem_conv(
+                xx, ww, 4, _INTERPRET, "float32")), argnums=(0, 1)))
+        xla_loss = jax.jit(jax.value_and_grad(
+            loss(lambda xx, ww: _conv(xx, ww, 4)), argnums=(0, 1)))
+        val_p, (dx_p, dw_p) = pallas_loss(x, w)
+        val_x, (dx_x, dw_x) = xla_loss(x, w)
+        np.testing.assert_allclose(val_p, val_x, rtol=1e-6)
+        np.testing.assert_allclose(dx_p, dx_x, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(dw_p, dw_x, rtol=2e-5, atol=2e-5)
+
+
+class TestPallasStemConvModule:
+    def _frame(self, seed=23):
+        return jax.random.randint(
+            jax.random.key(seed), (2, 24, 32, 3), 0, 255, jnp.int32
+        ).astype(jnp.uint8)
+
+    def test_checkpoint_interchangeable_with_nn_conv(self):
+        """Same param tree (kernel [K,K,C,F] + bias under the module
+        name) and the same function of those params — a torso
+        checkpoint written by either backend restores into the other
+        (the _SpaceToDepthFirstConv contract)."""
+        from scalable_agent_tpu.models import networks
+
+        xla = networks.ShallowConvTorso(conv_backend="xla")
+        pallas = networks.ShallowConvTorso(conv_backend="pallas")
+        frame = self._frame()
+        params = xla.init(jax.random.key(0), frame)
+        params_p = pallas.init(jax.random.key(0), frame)
+        assert (jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(params_p))
+        assert (jax.tree_util.tree_map(jnp.shape, params)
+                == jax.tree_util.tree_map(jnp.shape, params_p))
+        out_x = xla.apply(params, frame)
+        out_p = pallas.apply(params, frame)  # the XLA checkpoint
+        np.testing.assert_allclose(out_x, out_p, rtol=1e-6, atol=1e-6)
+
+    def test_torso_grads_match_xla_backend(self):
+        """End-to-end through the torso: the two backends are the same
+        mathematical function, so loss gradients agree to f32 kernel
+        tolerance."""
+        from scalable_agent_tpu.models import networks
+
+        frame = self._frame(29)
+        xla = networks.ShallowConvTorso(conv_backend="xla")
+        pallas = networks.ShallowConvTorso(conv_backend="pallas")
+        params = xla.init(jax.random.key(1), frame)
+
+        def grads(torso):
+            return jax.grad(
+                lambda p: jnp.sum(torso.apply(p, frame) ** 2))(params)
+
+        gx, gp = grads(xla), grads(pallas)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=5e-5, atol=5e-5), gx, gp)
+
+    def test_unknown_backend_rejected(self):
+        from scalable_agent_tpu.models import networks
+
+        with pytest.raises(ValueError, match="conv_backend"):
+            networks.ShallowConvTorso(conv_backend="tensorrt").init(
+                jax.random.key(0), self._frame())

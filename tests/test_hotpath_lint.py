@@ -32,8 +32,9 @@ PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
 # the on-device environment package (ISSUE 15): a debug print or
 # callback in an env step path would ride INSIDE the fused megastep's
 # scan — per-step host chatter at rollout frequency, the worst spot of
-# all.
-HOT_DIRS = ("runtime", "models", os.path.join("envs", "device"))
+# all.  ops holds the Pallas kernels (ISSUE 18) — a callback there
+# would sit inside the innermost MXU loop of every update.
+HOT_DIRS = ("runtime", "models", "ops", os.path.join("envs", "device"))
 
 # Callee names that are host callbacks regardless of how they are
 # reached (bare name, jax.pure_callback, jax.experimental.io_callback,
@@ -152,6 +153,8 @@ def test_hot_dirs_exist_and_are_scanned():
     assert os.path.join("runtime", "learner.py") in names
     assert os.path.join("envs", "device", "gridworld.py") in names
     assert os.path.join("envs", "device", "fake.py") in names
+    assert os.path.join("ops", "conv_pallas.py") in names
+    assert os.path.join("ops", "lstm_pallas.py") in names
 
 
 # -- registry closure: DEVICE_LEVELS <-> conformance parametrization ---------
@@ -195,3 +198,38 @@ def test_every_device_level_has_a_conformance_parametrization():
     assert not stale, (
         f"stale CONFORMANCE_LEVELS entries (level no longer "
         f"registered — delete them): {sorted(stale)}")
+
+
+# -- registry closure: CONV_BACKENDS <-> torso routing <-> driver -------------
+
+
+def test_every_conv_backend_routes_through_every_torso():
+    """Registry closure (ISSUE 18 satellite): every backend in
+    CONV_BACKENDS must actually build through BOTH torso classes (a
+    registered name a torso silently ignores would flip the stem back
+    to XLA while the flag claims Pallas), the driver's auto resolution
+    must land inside the registry, and an unregistered name must be
+    rejected — the flag surface and the routing cannot drift apart."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from scalable_agent_tpu import driver
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.models.networks import CONV_BACKENDS, TORSOS
+
+    frame = jnp.zeros((2, 32, 32, 3), jnp.uint8)
+    for backend in CONV_BACKENDS:
+        for name, torso_cls in TORSOS.items():
+            torso = torso_cls(conv_backend=backend)
+            params = torso.init(jax.random.key(0), frame)
+            out = torso.apply(params, frame)
+            assert out.shape[0] == 2, (name, backend)
+
+    config = Config(mode="train", level_name="fake_bandit",
+                    logdir="/tmp/unused", conv_backend="auto")
+    assert driver.resolve_conv_backend(config) in CONV_BACKENDS
+    with pytest.raises(ValueError, match="conv_backend"):
+        driver.resolve_conv_backend(
+            Config(mode="train", level_name="fake_bandit",
+                   logdir="/tmp/unused", conv_backend="winograd"))

@@ -110,6 +110,50 @@ ENTRY %main (a: f32[128,64], b: f32[64,32]) -> f32[128,32] {
         by_name = {row["name"]: row for row in table["kernels"]}
         assert by_name["env.1"]["scope"] == "env"
 
+    def test_pallas_gradw_custom_call_flops(self):
+        """ISSUE 18: a pallas_call lowers to a custom-call XLA cannot
+        see inside, so the named grad-W kernel gets an explicit cost —
+        2 * N*OH*OW * rows * F off the operand/result shapes — instead
+        of the one-flop-per-element floor (which would misprice the MXU
+        matmul by ~3 orders of magnitude and hide it from the
+        worst-kernel verdict)."""
+        hlo = """
+ENTRY %main (xs: bf16[256,19,25,48], g: bf16[256,18,24,32]) -> f32[768,32] {
+  %xs = bf16[256,19,25,48]{3,2,1,0} parameter(0)
+  %g = bf16[256,18,24,32]{3,2,1,0} parameter(1)
+  ROOT %cc.1 = f32[768,32]{1,0} custom-call(bf16[256,19,25,48]{3,2,1,0} %xs, bf16[256,18,24,32]{3,2,1,0} %g), custom_call_target="tpu_custom_call", metadata={op_name="jit(update)/pallas_conv0_gradw/pallas_call"}
+}
+"""
+        costs = kernels_lib.parse_hlo_kernel_costs(hlo)
+        # The g operand is the 4-d input whose trailing dim matches the
+        # result's feature dim; contraction length is its N*OH*OW.
+        assert costs["cc.1"]["flops_est"] == pytest.approx(
+            2 * (256 * 18 * 24) * 768 * 32)
+        assert costs["cc.1"]["op"] == "custom-call"
+
+    def test_unrecognized_custom_call_keeps_elementwise_floor(self):
+        """A custom-call without a registered Pallas cost entry must
+        stay on the explicit one-flop-per-element floor, not crash or
+        inherit another kernel's formula."""
+        hlo = """
+ENTRY %main (a: f32[64,32]) -> f32[64,32] {
+  %a = f32[64,32]{1,0} parameter(0)
+  ROOT %cc.9 = f32[64,32]{1,0} custom-call(f32[64,32]{1,0} %a), custom_call_target="tpu_custom_call", metadata={op_name="jit(update)/some_other_kernel/pallas_call"}
+}
+"""
+        costs = kernels_lib.parse_hlo_kernel_costs(hlo)
+        assert costs["cc.9"]["flops_est"] == 64 * 32
+
+    def test_gradw_marker_matches_ops_contract(self):
+        """The cost-model marker string and ops/conv_pallas.py's
+        GRADW_KERNEL_NAME are the same contract — kernels.py is
+        jax-free so it cannot import the op; this pins the two sides
+        together."""
+        from scalable_agent_tpu.ops import conv_pallas
+
+        assert (kernels_lib._PALLAS_GRADW_MARKER
+                == conv_pallas.GRADW_KERNEL_NAME)
+
     def test_real_compiled_module_parses_and_names_ops(self):
         compiled, _ = _compiled_conv_dot()
         costs = kernels_lib.parse_hlo_kernel_costs(compiled.as_text())

@@ -93,6 +93,23 @@ def test_host_driver_learns_bandit(tmp_path):
 
 
 @pytest.mark.slow
+def test_bf16_compute_learns_bandit(tmp_path):
+    """ISSUE 18: bf16 compute end-to-end (f32 params, bf16
+    activations/matmuls, f32 loss and V-trace) learns fake_bandit
+    through the same driver path and the same curve thresholds as the
+    f32 run above — the low-precision policy must match the f32 curve's
+    acceptance window, not merely stay finite."""
+    from scalable_agent_tpu import driver
+
+    updates = 200
+    config = _train_config(tmp_path / "run", updates,
+                           compute_dtype="bfloat16")
+    driver.train(config)
+    _assert_learned(_episode_returns(tmp_path / "run"),
+                    BANDIT_RANDOM, updates)
+
+
+@pytest.mark.slow
 def test_ingraph_driver_learns_bandit(tmp_path):
     """The fused in-graph backend learns the same level through the
     same driver entry point (--train_backend=ingraph)."""
