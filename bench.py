@@ -2204,6 +2204,102 @@ def bench_resilience(diag, budget_s=90.0):
     diag["resilience_secs"] = round(time.perf_counter() - t_start, 1)
 
 
+# The audit cadence the sentinel's amortized cost is quoted at
+# (docs/robustness.md derives the K=512 recommendation from this
+# stage's audit-vs-update ratio).
+SENTINEL_INTERVAL_REF = 512
+
+
+def bench_sentinel(diag, budget_s=240.0):
+    """Sentinel stage (ISSUE 19): price the numerics sentinel's three
+    costs (runtime/sentinel.py) so ``--sentinel_interval`` is chosen
+    from data, not vibes:
+
+    - **shadow audit**: one hot-vs-reference gradient + param-delta
+      recompute on the production shapes, amortized at the reference
+      cadence K=512 → ``sentinel_frac_on_update`` (the guard's key);
+    - **fingerprint**: the uint32 param-tree checksum + D2H, per call
+      → ``sentinel_fingerprint_us`` (paid every 8 updates);
+    - **ladder re-jit**: building + AOT-compiling the fully-demoted
+      reference learner (XLA stem, f32, two-pass loss) — what a
+      demotion or the first audit pays once → ``sentinel_rejit_s``.
+
+    The audit runs through the real :class:`NumericsSentinel` (its own
+    jit, its own D2H sync), so the measured number includes everything
+    the driver pays.  A clean run that BREACHES here is itself a
+    finding: the hot and reference arms disagree past
+    ``--sentinel_rtol`` with no fault injected."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.runtime.sentinel import NumericsSentinel
+
+    t_start = time.perf_counter()
+    cpu = diag.get("platform") == "cpu"
+    batch = 8 if cpu else 32
+    diag["sentinel_batch"] = batch
+    sub = {"errors": diag["errors"]}
+
+    # Hot arm: the shipping defaults (bf16 compute, fused loss).
+    hot_learner, update, state, traj, _, _ = _bench_learner_setup(
+        batch, sub)
+    once, state, _ = _timed_updates(update, state, traj, 1)
+    per_run_s = min(budget_s / 10.0, 10.0)
+    iters = max(3, min(50, int(per_run_s / max(once, 1e-4))))
+    diag["sentinel_iters"] = iters
+    dt_update, state, _ = _timed_updates(update, state, traj, iters)
+    diag["sentinel_sec_per_update"] = round(dt_update, 6)
+
+    # The ladder's re-jit price: rebuild + compile at the reference
+    # arms.  Same construction path as a real demotion (the ladder
+    # rebuilds agent+learner and the next update re-jits).
+    t0 = time.perf_counter()
+    ref_learner, ref_update, ref_state, ref_traj, _, _ = (
+        _bench_learner_setup(
+            batch, {"errors": diag["errors"]},
+            agent_overrides={"compute_dtype": jnp.float32},
+            learner_overrides={"fused_forward": False}))
+    diag["sentinel_rejit_s"] = round(time.perf_counter() - t0, 2)
+    del ref_update, ref_state, ref_traj
+
+    # The real sentinel, pointed at the two prebuilt learners (the
+    # rebuild closure hands back the reference arm).
+    config = Config(sentinel_interval=SENTINEL_INTERVAL_REF)
+    sentinel = NumericsSentinel(
+        config, None, hot_learner,
+        rebuild=lambda cfg: (None, ref_learner))
+    snap = sentinel.snapshot(state)
+    t0 = time.perf_counter()
+    state = sentinel.audit(snap, traj, state, updates=0)
+    diag["sentinel_audit_compile_s"] = round(
+        time.perf_counter() - t0, 2)
+    audit_iters = max(2, iters // 2)
+    t0 = time.perf_counter()
+    for i in range(audit_iters):
+        state = sentinel.audit(snap, traj, state, updates=i + 1)
+    dt_audit = (time.perf_counter() - t0) / audit_iters
+    diag["sentinel_audit_sec"] = round(dt_audit, 6)
+    diag["sentinel_audit_vs_update"] = round(dt_audit / dt_update, 3)
+    diag["sentinel_frac_on_update"] = round(
+        dt_audit / (SENTINEL_INTERVAL_REF * dt_update), 6)
+    if sentinel.rung != 0:
+        diag["errors"].append(
+            f"bench_sentinel: the hot-vs-reference audit breached on a "
+            f"clean run (demoted to rung {sentinel.rung}) — the arms "
+            f"disagree past --sentinel_rtol with no fault injected")
+
+    fp_iters = max(10, iters * 2)
+    sentinel.local_fingerprint(state.params)  # compile
+    t0 = time.perf_counter()
+    for _ in range(fp_iters):
+        sentinel.local_fingerprint(state.params)
+    diag["sentinel_fingerprint_us"] = round(
+        (time.perf_counter() - t0) / fp_iters * 1e6, 1)
+    del sentinel, hot_learner, ref_learner, update, state, traj, snap
+    diag["sentinel_secs"] = round(time.perf_counter() - t_start, 1)
+
+
 def _timed_sampled_updates(update, state, buf, iters):
     """``_timed_updates`` with the batch drawn from the replay slab
     each iteration — the real sampled-update path (gather + update),
@@ -2661,6 +2757,51 @@ def resilience_regression_guard(diag):
         diag.setdefault("warnings", []).append(
             f"resilience: a skipped update runs {ratio}x a normal one "
             f"(expected ~1x — the guard's selects should be free)")
+
+
+# The sentinel's budget on the update stage (ISSUE 19 acceptance): one
+# shadow audit amortized over --sentinel_interval=512 updates must stay
+# under 1% — corruption defense priced like the other planes.
+SENTINEL_BUDGET_FRAC = 0.01
+
+# The sentinel keys bench_sentinel publishes (obs-guard-style
+# missing-key protection: a key the previous round had must not
+# silently vanish).
+SENTINEL_GUARD_KEYS = (
+    "sentinel_frac_on_update",
+    "sentinel_fingerprint_us",
+    "sentinel_rejit_s",
+)
+
+
+def sentinel_regression_guard(diag, bench_dir=None):
+    """ISSUE 19 acceptance: fail the bench when the shadow audit,
+    amortized at the reference cadence (K=512), exceeds 1% of the
+    update stage — binding on TPU, advisory on the CPU fallback where
+    host scheduling dominates two independently compiled programs
+    (the resilience-guard discipline).  Also obs-guard-style: a
+    sentinel key the previous round's artifact published that this
+    round didn't is always an error."""
+    frac = diag.get("sentinel_frac_on_update")
+    if frac is not None and frac > SENTINEL_BUDGET_FRAC:
+        msg = (
+            f"SENTINEL: shadow-audit overhead {frac:.3%} of the update "
+            f"stage at --sentinel_interval={SENTINEL_INTERVAL_REF} "
+            f"exceeds the {SENTINEL_BUDGET_FRAC:.0%} budget (audit "
+            f"{diag.get('sentinel_audit_sec')}s vs update "
+            f"{diag.get('sentinel_sec_per_update')}s)")
+        guard_flag(diag, msg,
+                   advisory_note=" — CPU fallback: advisory, host "
+                   "scheduling dominates two independently compiled "
+                   "programs")
+    prev, ref_name = _latest_bench_artifact(diag, bench_dir)
+    if not prev or prev.get("platform") != diag.get("platform"):
+        return
+    for key in SENTINEL_GUARD_KEYS:
+        if prev.get(key) and diag.get(key) is None:
+            diag["errors"].append(
+                f"SENTINEL REGRESSION: {key} missing this round "
+                f"(previous round: {prev[key]}, {ref_name})")
 
 
 # The fleet layer's budget on the update stage (ISSUE 5 acceptance):
@@ -3402,6 +3543,11 @@ SUITE_REGISTRY = (
               lambda result, diag, ctx: bench_resilience(
                   diag, budget_s=_suite_budget(diag, 90.0, 45.0)), 600,
               "fused non-finite guard cost + NaN-skip path rate"),
+    SuiteSpec("bench_sentinel",
+              lambda result, diag, ctx: bench_sentinel(
+                  diag, budget_s=_suite_budget(diag, 240.0, 120.0)), 600,
+              "numerics-sentinel costs: shadow audit amortized at "
+              "K=512, param fingerprint, ladder re-jit"),
     SuiteSpec("bench_replay",
               lambda result, diag, ctx: bench_replay(
                   diag, budget_s=_suite_budget(diag, 300.0, 240.0)),
@@ -3523,6 +3669,11 @@ GUARD_REGISTRY = (
               lambda result, diag, bench_dir:
               resilience_regression_guard(diag), "tpu_binding",
               "fused finite check < 1% of the update stage"),
+    GuardSpec("sentinel_regression_guard",
+              lambda result, diag, bench_dir:
+              sentinel_regression_guard(diag, bench_dir), "tpu_binding",
+              "sentinel shadow audit < 1% of the update stage at "
+              "K=512; a published sentinel key going missing flags"),
     GuardSpec("replay_regression_guard",
               lambda result, diag, bench_dir: replay_regression_guard(
                   diag), "mixed",

@@ -291,6 +291,24 @@ class Config:
     # with --no_rollback).  0 disables the rollback policy; the guard
     # itself is always on.
     nonfinite_tolerance: int = 10
+    # Numerics sentinel (runtime/sentinel.py): every K updates, shadow-
+    # audit the hot path's gradients and param deltas against the
+    # reference path (XLA stem, f32 compute, two-pass loss) and demote
+    # down the degradation ladder on breach; also publish a param
+    # fingerprint per log interval and compare it across processes at
+    # the decision-broadcast cadence.  0 disables the sentinel entirely
+    # (the default path stays bit-exact).  In-graph runs require
+    # --updates_per_dispatch=1 while the sentinel is armed.
+    sentinel_interval: int = 0
+    # Max per-leaf L2-relative deviation ||hot - ref|| / (||ref|| + eps)
+    # any grad or param-delta leaf may show before an audit breaches.
+    # Calibrated against bench_sentinel's clean hot-vs-reference run at
+    # production shapes: legitimate bf16-vs-f32 drift measures ~0.38 on
+    # the worst (near-cancelled conv-bias) leaf, a 2x-miscomputing
+    # kernel reads exactly 1.0, and a param bit-flip dwarfs the
+    # reference delta's norm — 0.6 splits the bands with margin both
+    # ways.  Watch devtel/sentinel/max_deviation to re-calibrate.
+    sentinel_rtol: float = 0.6
     # Exit with code 71 instead of rolling back when the non-finite
     # tolerance is exhausted — the right setting under a supervisor
     # that reschedules the run (rollback-on-restart then happens via

@@ -132,7 +132,10 @@ from scalable_agent_tpu.runtime import (
     configure_fleet,
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
-from scalable_agent_tpu.runtime.exit_codes import NONFINITE_EXIT_CODE
+from scalable_agent_tpu.runtime.exit_codes import (
+    NONFINITE_EXIT_CODE,
+    SENTINEL_EXIT_CODE,
+)
 from scalable_agent_tpu.runtime.faults import (
     get_fault_injector,
     throughput_sag_s,
@@ -874,22 +877,27 @@ class _HealthPlane:
 
 def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
                       learner: Learner, state: TrainState,
-                      tracker: NonFiniteTracker):
-    """The non-finite tolerance is exhausted: restore the newest
-    VERIFIED checkpoint (watchdog suspended across the read) and return
-    ``(state, updates, frames)`` on the rolled-back timeline — or raise
-    ``SystemExit(71)`` when rollback is disabled or impossible."""
+                      tracker: NonFiniteTracker,
+                      reason: str = "nonfinite",
+                      exit_code: int = NONFINITE_EXIT_CODE):
+    """A guard's tolerance is exhausted (``reason``: the non-finite
+    streak, or the numerics sentinel's surviving breach): restore the
+    newest VERIFIED checkpoint (watchdog suspended across the read) and
+    return ``(state, updates, frames)`` on the rolled-back timeline —
+    or raise ``SystemExit(exit_code)`` (71 non-finite / 73 sentinel)
+    when rollback is disabled or impossible."""
     recorder = get_flight_recorder()
     registry = get_registry()
+    guard = ("sentinel" if reason == "sentinel"
+             else "non-finite guard")
     if config.no_rollback:
         log.error(
-            "non-finite guard: %d consecutive skipped updates and "
-            "--no_rollback is set — exiting %d",
-            tracker.tolerance, NONFINITE_EXIT_CODE)
+            "%s: rollback wanted and --no_rollback is set — exiting %d",
+            guard, exit_code)
         recorder.record("rollback", "disabled",
-                        {"streak": tracker.tolerance})
-        recorder.dump_all("nonfinite:no_rollback")
-        raise SystemExit(NONFINITE_EXIT_CODE)
+                        {"streak": tracker.tolerance, "reason": reason})
+        recorder.dump_all(f"{reason}:no_rollback")
+        raise SystemExit(exit_code)
     watchdog = get_watchdog()
     # A long Orbax read is recovery, not a wedge: the learner heartbeat
     # must not trip stalled_thread (or --watchdog_abort) mid-restore.
@@ -904,16 +912,15 @@ def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
         # Checkpoints exist but none verified: with the tolerance
         # already exhausted there is nothing to roll back to — same
         # terminal outcome as having no checkpoint at all.
-        log.error("non-finite guard: %s", exc)
+        log.error("%s: %s", guard, exc)
         restored = None
     if restored is None:
         log.error(
-            "non-finite guard: tolerance exhausted and no restorable "
-            "checkpoint under %s — exiting %d", config.logdir,
-            NONFINITE_EXIT_CODE)
-        recorder.record("rollback", "no_checkpoint", {})
-        recorder.dump_all("nonfinite:no_checkpoint")
-        raise SystemExit(NONFINITE_EXIT_CODE)
+            "%s: rollback wanted and no restorable checkpoint under "
+            "%s — exiting %d", guard, config.logdir, exit_code)
+        recorder.record("rollback", "no_checkpoint", {"reason": reason})
+        recorder.dump_all(f"{reason}:no_checkpoint")
+        raise SystemExit(exit_code)
     step, host_state = restored
     # Zero the streak so the restored timeline gets the full tolerance
     # again (the checkpoint may have been saved mid-streak).
@@ -923,17 +930,18 @@ def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
     state = learner.place_state(host_state)
     registry.counter(
         "learner/rollbacks_total",
-        "rollbacks to the last good checkpoint after the non-finite "
-        "tolerance was exhausted").inc()
+        "rollbacks to the last good checkpoint after a guard's "
+        "tolerance was exhausted (non-finite streak or sentinel "
+        "breach)").inc()
     frames = _host_scalar(state.env_frames)
     recorder.record("rollback", "restored",
-                    {"step": step, "env_frames": frames})
+                    {"step": step, "env_frames": frames,
+                     "reason": reason})
     tracker.rebase(_host_scalar(state.nonfinite_skips))
     watchdog.touch("learner")
     log.warning(
-        "non-finite guard: rolled back to checkpoint step %d "
-        "(%.0f frames) after %d consecutive skipped updates",
-        step, frames, tracker.tolerance)
+        "%s: rolled back to checkpoint step %d (%.0f frames)",
+        guard, step, frames)
     return state, step, frames
 
 
@@ -1005,6 +1013,7 @@ def train(config: Config) -> Dict[str, float]:
         logdir=config.logdir,
         process_index=jax.process_index())
     pool = prefetch_thread = writer = ckpt = learner = None
+    sentinel = None
     prefetch_stop = threading.Event()
     profiling = False
     completed = False
@@ -1030,6 +1039,13 @@ def train(config: Config) -> Dict[str, float]:
         # --replay_ratio replayed updates ride behind each fresh one —
         # None (and nothing allocated) when the dial is at 0.
         replay = build_replay(config, learner)
+        # Numerics sentinel (runtime/sentinel.py): shadow audits of the
+        # optimized hot path against the reference arm every
+        # --sentinel_interval updates, param fingerprints at the
+        # decision-broadcast cadence, and the degradation ladder on
+        # breach.  None (and no jitted program changes anywhere) when
+        # the dial is at 0 — the default path stays bit-exact.
+        sentinel = build_sentinel(config, agent, learner, action_space)
 
         # gloo (the multi-process CPU collectives transport) pairs ops
         # by ARRIVAL order per process-pair: no two programs with
@@ -1217,6 +1233,13 @@ def train(config: Config) -> Dict[str, float]:
             # window owns its end (retire stamps + close, or the
             # rollback discard's retired=False close).
             ledger_tid = ledger.lookup(id(traj))
+            audit_snap = None
+            if sentinel is not None and sentinel.audit_due(updates):
+                # Pre-update snapshot for the shadow audit below: the
+                # hot update donates its input state, so the audit
+                # needs its own buffers (the trajectory is not
+                # donated and rides through as-is).
+                audit_snap = sentinel.snapshot(state)
             with timing.time_avg("update"), interval.add_time("update"):
                 state, dispatched = learner.update(state, traj)
                 # Chaos: a deterministic mid-run slowdown (thermal
@@ -1238,7 +1261,37 @@ def train(config: Config) -> Dict[str, float]:
                 # and gloo mispairs anything that arrives alongside it.
                 jax.block_until_ready(state)
             watchdog.touch("learner")
-            if replay is not None:
+            if audit_snap is not None:
+                # Shadow audit: recompute this batch's grads + param
+                # delta through the reference arm on device and compare
+                # (one D2H bool at audit cadence).  Runs BEFORE the
+                # replay updates below so the delta compare sees the
+                # fresh update's params, and may demote the ladder —
+                # in which case the next update re-jits on the demoted
+                # learner (the prefetch thread keeps the old learner's
+                # transport; its placed trajectories feed the new
+                # learner unchanged — computation follows data).
+                with timing.time_avg("audit"), \
+                        interval.add_time("audit"):
+                    state = sentinel.audit(audit_snap, traj, state,
+                                           updates)
+                audit_snap = None
+                if sentinel.consume_swap():
+                    # Flush the old hot path's devtel before dropping
+                    # it, then adopt the demoted learner.  The replay
+                    # slab's lineage is suspect (filled by the breached
+                    # path) — drop it and re-warm.
+                    learner.publish_device_telemetry()
+                    learner = sentinel.learner
+                    agent = sentinel.agent
+                    if replay is not None:
+                        replay.flush()
+            # The size gate covers the re-warm-up window after a
+            # rollback/demotion flush: the slab refills from the
+            # prefetch thread's uploads, and until the first lands the
+            # replayed updates are simply skipped (fresh training
+            # continues at ratio 0) rather than sampling an empty ring.
+            if replay is not None and replay.size >= 1:
                 # The off-policy dial: R replayed updates behind every
                 # fresh batch — on-device sample + unpack + update,
                 # env_frames held (fresh frames count exactly once),
@@ -1402,6 +1455,8 @@ def train(config: Config) -> Dict[str, float]:
                 # cadence), folded into the registry as devtel/* so it
                 # rides the writer/prom dumps below.
                 learner.publish_device_telemetry()
+                if sentinel is not None:
+                    sentinel.publish()
                 # Ledger derivation BEFORE stall attribution, so the
                 # verdict line carries this interval's dominant-stage
                 # share (rates/ρ/staleness/MFU land in the registry and
@@ -1460,7 +1515,16 @@ def train(config: Config) -> Dict[str, float]:
             # to the coordinator, whose broadcast verdict commits
             # everyone at once.
             do_rollback = rollback_wanted
+            rollback_reason = "nonfinite"
             do_preempt = fleet.preemption_requested()
+            # Param fingerprint at the decision-broadcast cadence: an
+            # update-counter gate (identical on every process, unlike
+            # wall clocks) so the multi-process allgather below is
+            # issued on the same iteration everywhere — the gloo
+            # arrival-order discipline of the broadcast it rides with.
+            fingerprint = None
+            if sentinel is not None and updates % 8 == 0:
+                fingerprint = sentinel.local_fingerprint(state.params)
             if jax.process_count() > 1:
                 do_rollback = do_preempt = False
                 if updates % 8 == 0:
@@ -1470,8 +1534,27 @@ def train(config: Config) -> Dict[str, float]:
                         verdict = multihost_utils.broadcast_one_to_all(
                             np.asarray([rollback_wanted,
                                         fleet.preemption_requested()]))
+                        if fingerprint is not None:
+                            gathered = multihost_utils.process_allgather(
+                                np.asarray([fingerprint], np.float64))
                     do_rollback = bool(verdict[0])
                     do_preempt = bool(verdict[1])
+                    if (fingerprint is not None
+                            and sentinel.check_fingerprints(gathered)):
+                        # Replicas disagree bit-exact: SDC or a
+                        # divergent replica.  Every process sees the
+                        # same gathered set, so every process reaches
+                        # this verdict together — no extra broadcast.
+                        do_rollback = True
+                        rollback_reason = "sentinel"
+            if sentinel is not None and sentinel.rollback_pending:
+                # An audit breach survived the full degradation ladder:
+                # the sentinel wants the newest verified checkpoint.
+                # The audit cadence is update-counter gated, so every
+                # process set this flag on the same iteration —
+                # SPMD-consistent without a broadcast.
+                do_rollback = True
+                rollback_reason = "sentinel"
             if do_preempt:
                 # Coordinated preemption drain: fall through to the
                 # normal shutdown tail below — in-flight window
@@ -1488,12 +1571,24 @@ def train(config: Config) -> Dict[str, float]:
             if do_rollback:
                 rollback_wanted = False
                 state, updates, frames = _rollback_or_exit(
-                    config, ckpt, learner, state, nonfinite)
+                    config, ckpt, learner, state, nonfinite,
+                    reason=rollback_reason,
+                    exit_code=(SENTINEL_EXIT_CODE
+                               if rollback_reason == "sentinel"
+                               else NONFINITE_EXIT_CODE))
                 # Nothing from the abandoned timeline may leak forward:
-                # drop in-flight metrics (without blocking on them) and
-                # republish the restored weights.
+                # drop in-flight metrics (without blocking on them),
+                # flush the replay slab (its trajectories are the
+                # abandoned lineage's — stale-lineage samples must not
+                # feed post-restore updates; the off-policy dial
+                # re-warms from fresh batches), and republish the
+                # restored weights.
                 inflight.discard()
                 metrics = {}
+                if replay is not None:
+                    replay.flush()
+                if sentinel is not None and rollback_reason == "sentinel":
+                    sentinel.note_rollback()
                 pool.set_params(state.params, version=updates)
                 last_log = time.monotonic()
                 frames_at_last_log = frames
@@ -1572,6 +1667,11 @@ def train(config: Config) -> Dict[str, float]:
                 learner.publish_device_telemetry()
             except Exception:
                 log.exception("final device-telemetry publish failed")
+        if sentinel is not None:
+            try:
+                sentinel.publish()
+            except Exception:
+                log.exception("final sentinel-telemetry publish failed")
         if writer is not None:
             writer.close()
         if ckpt is not None:
@@ -1723,6 +1823,25 @@ def build_replay(config: Config, learner: Learner):
     return replay
 
 
+def build_sentinel(config: Config, agent, learner, action_space):
+    """The numerics sentinel for one training run (None when
+    ``--sentinel_interval=0``, the default — nothing constructed,
+    nothing jitted, no hot-path change).  Shared by both train
+    backends; the rebuild closure routes every ladder rung and the
+    reference arm through the SAME agent/learner factories as the
+    original construction, so a demoted path is exactly the path the
+    corresponding flags would have built."""
+    if config.sentinel_interval <= 0:
+        return None
+    from scalable_agent_tpu.runtime.sentinel import NumericsSentinel
+
+    def rebuild(cfg):
+        rebuilt_agent = build_agent(cfg, action_space)
+        return rebuilt_agent, build_training_learner(cfg, rebuilt_agent)
+
+    return NumericsSentinel(config, agent, learner, rebuild)
+
+
 # How many fused updates may be dispatched-but-unretired before the
 # in-graph loop forces one materialization to retire them: safely under
 # the ledger's 8192 open-record capacity, and high enough that the
@@ -1765,6 +1884,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             "replay_ratio > 0 requires --updates_per_dispatch=1: "
             "replayed updates interleave with fresh ones between "
             "dispatches (runtime/ingraph.py)")
+    if config.sentinel_interval > 0 and config.updates_per_dispatch > 1:
+        raise ValueError(
+            "sentinel_interval > 0 requires --updates_per_dispatch=1: "
+            "the shadow audit snapshots state at update granularity "
+            "(runtime/sentinel.py)")
     config = apply_env_overrides(config)
     config.save()
     configure_faults(config.chaos_spec)  # disarmed again in the finally
@@ -1792,11 +1916,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             f"envs/device/ must stay in lock-step)")
 
     learner = build_training_learner(config, agent)
+    # The sentinel's shadow audit consumes the dispatch's emitted
+    # trajectory, so arming it turns emission on like replay does.
+    emitting = config.replay_ratio > 0 or config.sentinel_interval > 0
     trainer = InGraphTrainer(
         agent, learner, env, config.unroll_length,
         config.batch_size, seed=config.seed,
-        emit_trajectory=config.replay_ratio > 0,
+        emit_trajectory=emitting,
         updates_per_dispatch=config.updates_per_dispatch)
+    sentinel = build_sentinel(config, agent, learner, action_space)
     # Device replay for the fused backend: the unroll's device-born
     # Trajectory pytree goes straight into the slab (no transport in
     # this backend, so no packed buffer to store — the per-leaf slabs
@@ -1920,7 +2048,15 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # (jax.random.fold_in), so resume continues the exact
                     # action-sampling stream the interrupted run would
                     # have used.
-                    if replay is None:
+                    if sentinel is not None and sentinel.audit_due(
+                            updates):
+                        # Pre-update snapshot for the shadow audit
+                        # below — train_step donates (state, carry),
+                        # so the audit needs its own buffers.
+                        audit_snap = sentinel.snapshot(state)
+                    else:
+                        audit_snap = None
+                    if not emitting:
                         state, carry, metrics = trainer.train_step(
                             state, carry, np.int32(updates))
                     else:
@@ -1936,6 +2072,51 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         "throughput_sag"):
                     with timing.time_avg("update"):
                         time.sleep(throughput_sag_s())
+                if audit_snap is not None:
+                    # Shadow audit on the dispatch's emitted trajectory
+                    # (same batch the fused update trained on), before
+                    # any replay updates move the params.
+                    with timing.time_avg("audit"):
+                        state = sentinel.audit(audit_snap, fresh_traj,
+                                               state, updates)
+                    audit_snap = None
+                    if sentinel.consume_swap():
+                        # Adopt the demoted learner: rebuild the fused
+                        # trainer around it (one re-jit at the next
+                        # dispatch).  The rollout carry is env-side
+                        # state and rides through unchanged — the
+                        # rollout rng is keyed by the update counter,
+                        # so the action stream stays continuous.  The
+                        # replay slab's lineage is suspect; drop it.
+                        # (Device telemetry rides the trainer CARRY in
+                        # this backend and survives the swap as-is.)
+                        learner = sentinel.learner
+                        agent = sentinel.agent
+                        trainer = InGraphTrainer(
+                            agent, learner, env, config.unroll_length,
+                            config.batch_size, seed=config.seed,
+                            emit_trajectory=emitting,
+                            updates_per_dispatch=updates_per_dispatch)
+                        if replay is not None:
+                            replay.flush()
+                if sentinel is not None and sentinel.rollback_pending:
+                    # A breach survived the full degradation ladder:
+                    # roll back to the newest verified checkpoint (or
+                    # exit 73).  Single-process backend — no broadcast
+                    # needed before acting.
+                    state, updates, frames = _rollback_or_exit(
+                        config, ckpt, learner, state, nonfinite,
+                        reason="sentinel",
+                        exit_code=SENTINEL_EXIT_CODE)
+                    sentinel.note_rollback()
+                    if replay is not None:
+                        replay.flush()
+                    if carry.streak_peak is not None:
+                        carry = carry._replace(
+                            streak_peak=jnp.zeros((), jnp.float32))
+                    last_log = time.monotonic()
+                    frames_at_last_log = frames
+                    continue
                 if replay is not None:
                     # Same off-policy dial as the host backend: the
                     # fresh unroll lands in the slab, then R replayed
@@ -2040,13 +2221,24 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     # obs fetch, folded into the registry for the prom
                     # dump below.
                     trainer.publish_telemetry(carry)
+                    if sentinel is not None:
+                        sentinel.publish()
                     ledger.publish()
                     if nonfinite.observe(host_metrics):
                         state, updates, frames = _rollback_or_exit(
                             config, ckpt, learner, state, nonfinite)
                         # The rollout carry is env-side state, not
                         # params — it rides through the rollback like
-                        # the host backend's env processes do.
+                        # the host backend's env processes do.  The
+                        # in-graph streak peak and the replay slab are
+                        # the abandoned timeline's: reset both so
+                        # neither a stale peak nor stale-lineage
+                        # samples leak past the restore.
+                        if replay is not None:
+                            replay.flush()
+                        if carry.streak_peak is not None:
+                            carry = carry._replace(
+                                streak_peak=jnp.zeros((), jnp.float32))
                         last_log = time.monotonic()
                         frames_at_last_log = frames
                         continue
@@ -2084,6 +2276,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                         " ".join(f"{k} {v:.4f}s"
                                  for k, v in timing_summary.items()))
                     last_log, frames_at_last_log = now, frames
+                if sentinel is not None and updates % 8 == 0:
+                    # Param fingerprint at the host backend's broadcast
+                    # cadence.  Single-process, so there is no peer to
+                    # compare against — the gauge (and the
+                    # replica_diverge chaos point's occurrence
+                    # counting) still ride it, and a postmortem can
+                    # diff two runs' series.
+                    sentinel.local_fingerprint(state.params)
                 if fleet.preemption_requested():
                     # Same per-iteration decision point as the host
                     # backend (single-process, so no broadcast): fall
@@ -2139,6 +2339,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             trainer.publish_telemetry(carry)
         except Exception:
             log.exception("final device-telemetry publish failed")
+        if sentinel is not None:
+            try:
+                sentinel.publish()
+            except Exception:
+                log.exception("final sentinel-telemetry publish failed")
         ckpt.close()
         _teardown_observability(config, obs_handles)
         configure_fleet(None)  # after obs: covers the whole tail

@@ -68,6 +68,39 @@ def build_diagnosis(logdir: str) -> dict:
     for verdict in verdicts:
         detector = _DETECTOR_FOR_VERDICT.get(verdict["name"])
         verdict["anomalies"] = by_detector.get(detector) or []
+    # The numerics sentinel (runtime/sentinel.py) reports through the
+    # same verdict channel: a trip means the shadow audit or the
+    # cross-process fingerprint caught the optimized hot path producing
+    # silently-wrong numbers — a run can look healthy on every
+    # learning-dynamics rule and still be poisoned, so a trip is never
+    # ignorable.
+    sentinel = {}
+    for short, name in (
+            ("trips", "sentinel/trips_total"),
+            ("demotions", "sentinel/demotions_total"),
+            ("fingerprint_mismatches",
+             "sentinel/fingerprint_mismatch_total"),
+            ("rung", "sentinel/rung"),
+            ("audits", "devtel/sentinel/audits_total"),
+            ("breaches", "devtel/sentinel/breaches_total"),
+            ("max_deviation", "devtel/sentinel/max_deviation")):
+        value = _value(families, name)
+        if value is not None:
+            sentinel[short] = value
+    if sentinel.get("trips"):
+        verdicts.append({
+            "name": "sentinel_tripped", "severity": "critical",
+            "observed": sentinel["trips"], "limit": 0.0,
+            "evidence": dict(sentinel),
+            "remedy": (
+                "the numerics sentinel caught silent corruption on "
+                "the optimized hot path: read the pinned flight "
+                "recorder dump (reason sentinel_trip:*), check "
+                "sentinel/rung for where the degradation ladder "
+                "settled, and requalify the demoted backend "
+                "(docs/robustness.md, silent-corruption defense) "
+                "before promoting it back"),
+            "anomalies": []})
     impact = {}
     for short, name in (
             ("ratio_mean", "devtel/learn/impact_ratio/mean"),
@@ -85,6 +118,7 @@ def build_diagnosis(logdir: str) -> dict:
         "source": source,
         "snapshot": snapshot,
         "impact": impact or None,
+        "sentinel": sentinel or None,
         "verdicts": verdicts,
         "clean": not verdicts,
         "staleness_clip": learning.staleness_clip_relationship(rows),
@@ -100,7 +134,11 @@ def render_diagnosis(diagnosis: dict) -> str:
             "no devtel/learn/* readings in the snapshot — the run "
             "predates the learning-dynamics plane or ran with "
             "--learn_telemetry=false")
-        return "\n".join(lines) + "\n"
+        if not diagnosis["verdicts"]:
+            return "\n".join(lines) + "\n"
+        # A sentinel trip must surface even without the learning
+        # plane's table — fall through to the verdict section.
+        lines.append("")
     for key, label, fmt in _TABLE:
         if key in snapshot:
             lines.append(f"  {label:<32}{format(snapshot[key], fmt)}")
@@ -131,6 +169,15 @@ def render_diagnosis(diagnosis: dict) -> str:
             parts.append(
                 f"over {impact['updates_observed']:.0f} updates")
         lines.append("  IMPACT anchor: " + ", ".join(parts))
+    sentinel = diagnosis.get("sentinel")
+    if sentinel:
+        lines.append("")
+        lines.append(
+            "  numerics sentinel: "
+            f"audits {sentinel.get('audits', 0):.0f}, "
+            f"breaches {sentinel.get('breaches', 0):.0f}, "
+            f"trips {sentinel.get('trips', 0):.0f}, "
+            f"ladder rung {sentinel.get('rung', 0):.0f}")
     relation = diagnosis.get("staleness_clip")
     if relation:
         lines.append("")

@@ -452,6 +452,25 @@ def build_report(logdir: str,
              for k in ("anomalies_total", "suppressed_total",
                        "profile_windows_total")) else None
 
+    # The numerics sentinel (runtime/sentinel.py): shadow-audit and
+    # fingerprint outcomes.  A trip with no matching explanation is a
+    # blocking finding — the r06 checklist's "sentinel quiet" gate
+    # (docs/benchmarking.md) reads this section.
+    sentinel = {}
+    for key, registry_name in (
+            ("audits", "devtel/sentinel/audits_total"),
+            ("breaches", "devtel/sentinel/breaches_total"),
+            ("max_deviation", "devtel/sentinel/max_deviation"),
+            ("trips", "sentinel/trips_total"),
+            ("demotions", "sentinel/demotions_total"),
+            ("fingerprint_mismatches",
+             "sentinel/fingerprint_mismatch_total"),
+            ("rung", "sentinel/rung")):
+        value = _value(families, registry_name)
+        if value is not None:
+            sentinel[key] = value
+    report["sentinel"] = sentinel or None
+
     report["kernels"] = _run_kernels(logdir)
     report["bench_kernels"] = _bench_kernels(bench_dir)
     # The device_bound split: once the verdict says the chip is the
@@ -685,6 +704,24 @@ def render_report(logdir: str, bench_dir: Optional[str] = None) -> str:
                 f"{_fmt(a.get('baseline'), '.4g')}{detail}  "
                 f"[{a.get('dominant_segment') or a.get('verdict') or '-'}]"
                 f"  window {wline}")
+
+    sentinel = report.get("sentinel")
+    if sentinel:
+        lines.append("")
+        trips = sentinel.get("trips", 0) or 0
+        status = ("QUIET" if not trips
+                  else f"{trips:.0f} trip(s) — explain each before "
+                       f"accepting the round")
+        lines.append(f"numerics sentinel: {status}")
+        lines.append(
+            f"  audits {sentinel.get('audits', 0):.0f}  "
+            f"breaches {sentinel.get('breaches', 0):.0f}  "
+            f"max deviation "
+            f"{_fmt(sentinel.get('max_deviation'), '.3g')}  "
+            f"demotions {sentinel.get('demotions', 0):.0f}  "
+            f"fingerprint mismatches "
+            f"{sentinel.get('fingerprint_mismatches', 0):.0f}  "
+            f"ladder rung {sentinel.get('rung', 0):.0f}")
 
     if report["kernels"]:
         _render_kernel_section(

@@ -524,6 +524,10 @@ TRAJECTORY_METRICS: Tuple[Tuple[str, str, str], ...] = (
     ("update_bf16_fps", "kernel-war bf16 update fps", "fps"),
     ("fused_forward_sec_per_update", "fused-loss sec/update", "s"),
     ("double_forward_sec_per_update", "double-forward sec/update", "s"),
+    ("sentinel_frac_on_update",
+     "sentinel audit share of update (K=512)", "frac"),
+    ("sentinel_fingerprint_us", "sentinel fingerprint cost", "us"),
+    ("sentinel_rejit_s", "sentinel ladder re-jit latency", "s"),
 )
 
 
@@ -639,6 +643,7 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
     worst_kernel: Dict[int, dict] = {}
     learning_curves: Dict[int, list] = {}
     anomalies: Dict[int, list] = {}
+    sentinel: Dict[int, dict] = {}
     scoreboard: Dict[int, Dict[str, dict]] = {}
 
     for art in parsed:
@@ -699,6 +704,17 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
                     and source["anomalies"]):
                 anomalies[art.round] = source["anomalies"]
                 break
+        # The numerics-sentinel scorecard rides the same channel: a
+        # round whose driver attach step recorded a ``sentinel`` dict
+        # (obs.report --json) states quiet-or-tripped per round, the
+        # r06 checklist's "sentinel quiet (or every trip explained)"
+        # gate (docs/benchmarking.md).
+        for source in (metrics, art.raw):
+            if (isinstance(source, dict)
+                    and isinstance(source.get("sentinel"), dict)
+                    and source["sentinel"]):
+                sentinel[art.round] = source["sentinel"]
+                break
         if metrics:
             scoreboard[art.round] = score_round(metrics)
 
@@ -737,6 +753,7 @@ def build_trajectory(bench_dir: Optional[str] = None) -> dict:
         "worst_kernel": worst_kernel,
         "learning_curves": learning_curves,
         "anomalies": anomalies,
+        "sentinel": sentinel,
         "multichip": load_multichip(bench_dir),
         "targets": [target._asdict() for target in R06_TARGETS],
         "scoreboard": scoreboard,
@@ -848,6 +865,23 @@ def render_trajectory(trajectory: dict) -> str:
                     f"{_fmt_value(record.get('baseline'))}{detail}  "
                     f"[{record.get('dominant_segment') or record.get('verdict') or '-'}]"
                     f"  window {window.get('status', '-')}")
+
+    sentinel = trajectory.get("sentinel") or {}
+    if sentinel:
+        lines.append("")
+        lines.append("numerics sentinel (runtime/sentinel.py):")
+        for round_no in sorted(sentinel):
+            record = sentinel[round_no]
+            trips = record.get("trips", 0) or 0
+            status = ("quiet" if not trips
+                      else f"{trips:.0f} trip(s) — EXPLAIN before "
+                           f"accepting")
+            lines.append(
+                f"  r{round_no:02d}  {status}  "
+                f"audits {record.get('audits', 0):.0f}  "
+                f"max dev {_fmt_value(record.get('max_deviation'))}  "
+                f"demotions {record.get('demotions', 0):.0f}  "
+                f"rung {record.get('rung', 0):.0f}")
 
     multichip = [m for m in trajectory["multichip"] if m.get("valid")]
     if multichip:

@@ -41,8 +41,13 @@ in PAPERS.md):
 - **Exit-code policy** (docs/robustness.md renders this): 72 and
   SIGABRT (134 / signal 6 — jax's own client fatal when the
   coordinator dies, see runtime/fleet.py) are *reshardable*; SIGKILL
-  marks the slot *lost*; 70 (watchdog wedge) restarts at the same
-  shape; 71 (non-finite) is *fatal* — something poisoned the regime
+  marks the slot *lost*; 70 (watchdog wedge) and 73 (the numerics
+  sentinel's silent-corruption verdict, runtime/sentinel.py) restart
+  at the same shape — a wedge clears on relaunch, and a sentinel trip
+  that survived the ladder + rollback points at transient hardware
+  state a fresh process may not share (the resumed run re-audits from
+  its first interval); 71 (non-finite) is *fatal* — something
+  poisoned the regime
   and a supervisor restarting blindly would just replay it; 0 is done
   — unless the epoch's verdict file says "preempt", in which case the
   drain was a checkpoint, not a finish line, and the fleet relaunches.
@@ -73,6 +78,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from scalable_agent_tpu.runtime.exit_codes import (
     FLEET_EXIT_CODE,
     NONFINITE_EXIT_CODE,
+    SENTINEL_EXIT_CODE,
     WATCHDOG_EXIT_CODE,
 )
 from scalable_agent_tpu.runtime.fleet import EPOCH_VERDICT_NAME
@@ -136,7 +142,10 @@ def classify_exit(code: int) -> str:
         return OK
     if code == NONFINITE_EXIT_CODE:
         return FATAL
-    if code == WATCHDOG_EXIT_CODE:
+    if code in (WATCHDOG_EXIT_CODE, SENTINEL_EXIT_CODE):
+        # 73: the sentinel exhausted its ladder + rollback — the shape
+        # is fine, the arithmetic wasn't; relaunch as-is and let the
+        # fresh process's audits re-judge the hardware.
         return RESTART_SAME
     if code in _SIGKILL_CODES:
         return LOST

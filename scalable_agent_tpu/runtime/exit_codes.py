@@ -11,6 +11,7 @@ for operators:
 | 70 | watchdog | obs/watchdog.py (``--watchdog_abort``) | a pipeline thread missed its heartbeat deadline — the run was wedged, forensics dumped |
 | 71 | non-finite | driver._rollback_or_exit | the non-finite tolerance was exhausted with ``--no_rollback`` or nothing restorable — numeric divergence, not a hang |
 | 72 | fleet | runtime/fleet.py | a peer process was lost (stale heartbeat, dead coordinator, timed-out collective) or the preemption grace window expired — restart and resume |
+| 73 | sentinel | runtime/sentinel.py via driver | the numerics sentinel detected silent corruption that survived the full degradation ladder and a rollback (or rollback was impossible) — the hardware/software combination is producing wrong arithmetic |
 
 ``128 + signum`` (e.g. 143 for SIGTERM with the grace protocol
 disabled) keeps its POSIX meaning; 0 is a completed run — including a
@@ -27,6 +28,7 @@ first place.
 WATCHDOG_EXIT_CODE = 70
 NONFINITE_EXIT_CODE = 71
 FLEET_EXIT_CODE = 72
+SENTINEL_EXIT_CODE = 73
 
 # name -> (code, one-line operator meaning); the docs table and the
 # exit-code tests render from this.
@@ -40,4 +42,9 @@ EXIT_CODES = {
     "fleet": (FLEET_EXIT_CODE,
               "peer lost / collective timed out / preemption grace "
               "expired — restart resumes from the last checkpoint"),
+    "sentinel": (SENTINEL_EXIT_CODE,
+                 "silent numeric corruption survived the full "
+                 "degradation ladder and a rollback — restart at the "
+                 "same shape (the reference path is trusted; persistent "
+                 "breach points at the hardware)"),
 }

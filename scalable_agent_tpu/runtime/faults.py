@@ -39,6 +39,21 @@ runtime's failure-prone seams —
 - ``preempt_sigterm`` (runtime/fleet.py): the process SIGTERMs itself,
   driving the preemption-grace protocol (coordinated final checkpoint,
   clean exit) deterministically.
+- ``param_bitflip`` (runtime/sentinel.py): flip one mantissa bit in
+  the param tree's largest-magnitude element right after an audited
+  update — a deterministic SDC stand-in; the sentinel's param-delta
+  arm must catch it within the same audit and walk the degradation
+  ladder (occurrences count audits).
+- ``kernel_miscompute`` (runtime/sentinel.py): scale the hot path's
+  audited gradients by 2x — a silently-wrong custom kernel stand-in;
+  the sentinel's gradient arm must breach and the first ladder rung
+  (``conv_backend pallas→xla``) must clear it (occurrences count
+  audits; only effective while the ladder is at rung 0).
+- ``replica_diverge`` (runtime/sentinel.py): XOR a constant into this
+  process's param fingerprint before the cross-process compare — a
+  divergent-replica stand-in; every process must see the mismatch at
+  the ``updates%8`` broadcast and agree to roll back (occurrences
+  count fingerprint computations).
 
 The three fleet points are armed per-process (each process parses its
 OWN ``--chaos_spec``), so a multi-process soak arms them on exactly one
@@ -71,6 +86,7 @@ from typing import Dict, FrozenSet
 from scalable_agent_tpu.obs import get_flight_recorder, get_registry
 
 __all__ = [
+    "CHAOS_POINTS",
     "FaultInjector",
     "InjectedFault",
     "THROUGHPUT_SAG_S",
@@ -79,6 +95,28 @@ __all__ = [
     "parse_chaos_spec",
     "throughput_sag_s",
 ]
+
+# Every injection point compiled into the runtime, name -> what firing
+# it simulates.  tests/test_chaos_lint.py holds this registry to the
+# coverage contract: each point must have a fault-matrix row in
+# docs/robustness.md and at least one exercising test, so a point can't
+# be added (or orphaned) without its recovery story.
+CHAOS_POINTS = {
+    "nan_grad": "poison one update's rewards with NaN",
+    "replay_corrupt": "poison one sampled replay batch's rewards",
+    "actor_raise": "raise from an actor thread's unroll loop",
+    "worker_kill": "SIGKILL one env worker process",
+    "ckpt_torn": "corrupt the just-written checkpoint on disk",
+    "ckpt_save_fail": "raise inside a cadenced checkpoint save",
+    "service_stall": "wedge the continuous-batching inference thread",
+    "throughput_sag": "sleep inside the update loop (mid-run slowdown)",
+    "peer_exit": "sudden peer process death (os._exit from monitor)",
+    "peer_hang": "heartbeat publisher falls silent (wedged peer)",
+    "preempt_sigterm": "self-SIGTERM driving the preemption protocol",
+    "param_bitflip": "flip a mantissa bit in a param leaf (SDC)",
+    "kernel_miscompute": "scale audited hot-path grads 2x (bad kernel)",
+    "replica_diverge": "corrupt this process's param fingerprint",
+}
 
 _ENTRY_RE = re.compile(r"([A-Za-z_][\w.]*)@(\d+(?::\d+)*)\Z")
 
@@ -175,6 +213,16 @@ class FaultInjector:
             raise InjectedFault(
                 f"injected fault at {point!r} "
                 f"(occurrence {self._counts[point]})")
+
+    def occurrences(self, point: str) -> FrozenSet[int]:
+        """The armed 1-based occurrence set for ``point`` WITHOUT
+        counting an evaluation.  For trace-time injection: in-graph
+        consumers (runtime/ingraph.py's megaloop) bake the set into the
+        compiled program and match it against the global update index
+        on device, so firings there are deterministic per update index
+        rather than per host evaluation — and are NOT counted in
+        ``faults/injected_total`` (the device can't call back out)."""
+        return self._points.get(point, frozenset())
 
     def counts(self) -> Dict[str, int]:
         """Evaluations seen per point (tests/diagnostics)."""

@@ -19,7 +19,7 @@ Per-update semantics match the host pipeline:
   on the on/off-policy spectrum the data sits.
 """
 
-from typing import Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,7 @@ from scalable_agent_tpu.obs.device_telemetry import (
     fetch_merged,
     merge_init,
 )
+from scalable_agent_tpu.runtime.faults import get_fault_injector
 from scalable_agent_tpu.runtime.learner import Learner, Trajectory
 from scalable_agent_tpu.types import AgentOutput, AgentState
 
@@ -62,6 +63,19 @@ class TrainCarry(NamedTuple):
 
     rollout: RolloutCarry
     telemetry: Dict
+    # The WORST consecutive non-finite-skip streak seen inside the
+    # megaloop since the host last acted on it (f32 scalar; None — an
+    # empty pytree node — when the finite guard is off).  TrainState
+    # carries the streak at the LAST update of a dispatch, so with
+    # K = updates_per_dispatch > 1 a streak that reaches the rollback
+    # tolerance mid-dispatch and then resets (one finite update) would
+    # be invisible at the dispatch boundary — up to K-1 skips past the
+    # documented trigger.  The peak is monotone across scan iterations
+    # AND across dispatches, surfaced as
+    # ``metrics['nonfinite_streak_peak']``; the host's NonFiniteTracker
+    # takes max(streak, peak), and the driver resets the peak to 0 on
+    # rollback (the only action that forgives a tolerance breach).
+    streak_peak: Any = None
 
 
 def _stack_first(first, seq):
@@ -163,7 +177,12 @@ class InGraphTrainer:
         carry = TrainCarry(
             rollout=RolloutCarry(env_state, env_output, agent_output,
                                  core_state),
-            telemetry=merge_init(self._tel_specs))
+            telemetry=merge_init(self._tel_specs),
+            # None (an empty pytree node, nothing allocated) when the
+            # finite guard is off — the carry structure then matches
+            # pre-peak checkpointed runs byte-for-byte.
+            streak_peak=(jnp.float32(0.0)
+                         if self._learner._finite_guard else None))
         example = Trajectory(
             agent_state=core_state,
             env_outputs=_stack_first(
@@ -223,6 +242,23 @@ class InGraphTrainer:
             jax.random.key(self._seed), update_index)
         trajectory, new_rollout = self._rollout(
             state.params, rollout_carry, rng)
+        # Chaos (trace-time): the host backend's ``nan_grad`` hook
+        # lives in Learner.update, which this fused path never calls —
+        # bake the armed occurrence set into the compiled program and
+        # match it against the GLOBAL update index on device instead
+        # (faults.occurrences: 1-based, so occurrence n poisons update
+        # index n-1's batch; not counted in faults/injected_total).
+        injector = get_fault_injector()
+        if injector.active:
+            armed = sorted(injector.occurrences("nan_grad"))
+            if armed:
+                fire = jnp.any(jnp.asarray(armed, jnp.int32)
+                               == update_index + 1)
+                poison = jnp.where(fire, jnp.float32(float("nan")),
+                                   jnp.float32(1.0))
+                trajectory = trajectory._replace(
+                    env_outputs=trajectory.env_outputs._replace(
+                        reward=trajectory.env_outputs.reward * poison))
         # The [1:] slice drops the T+1 overlap entry (it was the
         # PREVIOUS unroll's last step — counting it again would
         # double-book every episode boundary), for both the metrics
@@ -260,21 +296,28 @@ class InGraphTrainer:
         k = self._updates_per_dispatch
 
         def body(loop_carry, update_index):
-            state, rollout_carry, telemetry = loop_carry
+            state, rollout_carry, telemetry, peak = loop_carry
             (state, rollout_carry, telemetry, metrics, episode_sums,
              trajectory) = self._one_update(
                 state, rollout_carry, telemetry, update_index)
+            if peak is not None and "nonfinite_streak" in metrics:
+                # The megaloop's tolerance contract: fold the
+                # post-update streak into the monotone peak each
+                # iteration, so a streak that breaches mid-dispatch
+                # and then resets is still visible at the boundary.
+                peak = jnp.maximum(peak, metrics["nonfinite_streak"])
             ys = (metrics, episode_sums)
             if self._emit_trajectory:
                 ys = ys + (trajectory,)
-            return (state, rollout_carry, telemetry), ys
+            return (state, rollout_carry, telemetry, peak), ys
 
         # K == 1 runs through the SAME scan body: lax.scan compiles the
         # body as its own while-loop computation at any length, so a
         # K-update dispatch is bit-exact with K single-update dispatches
         # (the golden property driver resume / the K knob rely on).
-        (new_state, new_rollout, telemetry), ys = jax.lax.scan(
-            body, (state, rollout_carry, carry.telemetry),
+        (new_state, new_rollout, telemetry, peak), ys = jax.lax.scan(
+            body,
+            (state, rollout_carry, carry.telemetry, carry.streak_peak),
             counter + jnp.arange(k, dtype=jnp.int32))
         metrics_seq, episode_seq = ys[0], ys[1]
         # Scalar gauges (loss, lr, grad_norm, env_frames, ...) read the
@@ -286,7 +329,9 @@ class InGraphTrainer:
         metrics["episodes_completed"] = count
         metrics["episode_return"] = episode_seq["return_sum"].sum() / denom
         metrics["episode_frames"] = episode_seq["frames_sum"].sum() / denom
-        out_carry = TrainCarry(new_rollout, telemetry)
+        if peak is not None:
+            metrics["nonfinite_streak_peak"] = peak
+        out_carry = TrainCarry(new_rollout, telemetry, peak)
         if self._emit_trajectory:
             # K == 1 (enforced in __init__): drop the length-1 scan
             # axis so the replay tap sees the plain [T+1, B] pytree.

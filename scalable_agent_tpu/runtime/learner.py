@@ -1110,6 +1110,13 @@ class NonFiniteTracker:
         streak has reached the tolerance (caller rolls back / exits)."""
         total = float(host_metrics.get("nonfinite_skips", 0.0))
         streak = float(host_metrics.get("nonfinite_streak", 0.0))
+        # Megaloop contract (runtime/ingraph.py TrainCarry.streak_peak):
+        # the end-of-dispatch streak can have RESET mid-dispatch after
+        # breaching the tolerance; the carried peak is the worst streak
+        # since the last rollback, so the boundary check honors the
+        # documented trigger at any updates_per_dispatch.
+        streak = max(streak, float(
+            host_metrics.get("nonfinite_streak_peak", 0.0)))
         delta = total - self._last_total
         if delta > 0:
             self._counter.inc(delta)

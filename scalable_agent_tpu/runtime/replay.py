@@ -135,6 +135,10 @@ class DeviceReplayBuffer:
         self._c_samples = registry.counter(
             "replay/sampled_total",
             "trajectory batches sampled from the device replay slab")
+        self._c_flushes = registry.counter(
+            "replay/rollback_flushes_total",
+            "slab flushes dropping an abandoned timeline's trajectories "
+            "(rollback or sentinel demotion)")
         import weakref
 
         self_ref = weakref.ref(self)
@@ -156,6 +160,30 @@ class DeviceReplayBuffer:
     def size(self) -> int:
         """Valid slots (host mirror; exact — inserts are host-dispatched)."""
         return self._host_filled
+
+    def flush(self) -> None:
+        """Empty the ring WITHOUT freeing the slabs: occupancy -> 0, so
+        every stored trajectory becomes unreachable (sample() gates on
+        ``filled``; the stale slot bytes are dead until overwritten).
+
+        This is the rollback/demotion hygiene hook (driver.py): a
+        restored timeline (or a sentinel-demoted hot path) must not
+        train on the abandoned lineage's trajectories — the off-policy
+        dial re-warms from fresh batches, paced by the driver's
+        ``size >= 1`` sample gate.  The PRNG counter deliberately keeps
+        advancing (not reset): the sampling stream stays unique across
+        the flush, and a resumed run can't replay the pre-flush slot
+        choices against different slab contents."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._slabs is not None:
+                self._cursor = jnp.zeros((), jnp.int32)
+                self._filled = jnp.zeros((), jnp.int32)
+            self._host_cursor = 0
+            self._host_filled = 0
+            self._slot_birth_us = [0] * self.capacity
+        self._c_flushes.inc()
 
     # -- lazy construction -------------------------------------------------
 

@@ -1,0 +1,487 @@
+"""Numerics sentinel: silent-corruption defense for the optimized path.
+
+PR 18 put three fast-but-risky arms in the hot loop — the Pallas grad-W
+stem kernel (``--conv_backend``), bf16 compute end-to-end
+(``--compute_dtype``), and the fused single-forward loss
+(``--fused_forward``).  The non-finite guard (runtime/learner.py)
+catches *loud* wrongness; nothing catches a kernel that is silently
+off by 2x, bf16 drift past its modeled envelope, or an SDC bit-flip in
+the param slab.  This module is that defense, in three arms:
+
+1. **Shadow audits.**  Every ``--sentinel_interval`` updates the driver
+   snapshots the pre-update TrainState and the batch, runs the normal
+   hot update, then hands both to :meth:`NumericsSentinel.audit`, which
+   recomputes the same batch's gradients and the resulting param delta
+   through the *reference* path (XLA stem, f32 compute, two-pass loss —
+   the PR 18 bench baselines, still flag-reachable) entirely on device
+   and compares leaves against a calibrated relative tolerance
+   (``--sentinel_rtol``).  One D2H bool + a max-deviation gauge per
+   audit, surfaced through the devtel plane (``devtel/sentinel/...``).
+
+2. **Param fingerprints.**  A uint32 wrap-around checksum over the raw
+   bits of the param tree, computed on device at the existing
+   ``updates % 8`` decision-broadcast cadence.  Data-parallel replicas
+   must agree *bit-exact*; any disagreement across processes is SDC or
+   a divergent replica, and every process votes to roll back.  (The
+   reduction over a replicated array compiles to a local per-device
+   reduction — no collective — so each process's fetched value
+   reflects its OWN replica, which is exactly what makes the
+   cross-process compare meaningful.  Model-sharded leaves would fold
+   in a psum and hide per-replica bits; the shadow audits cover that
+   axis, see docs/robustness.md.)
+
+3. **Degradation ladder.**  On an audit breach the sentinel demotes the
+   hot path one rung at a time — ``conv_backend pallas→xla``, then
+   ``compute_dtype bf16→f32``, then ``fused→two-pass`` — rebuilding the
+   learner (one re-jit per rung) and counting
+   ``sentinel/demotions_total``.  A breach that survives the full
+   ladder requests a rollback to the newest verified checkpoint (the
+   PR 4 machinery); a breach after that rollback means the trusted
+   reference path itself can't be reproduced on this hardware, and the
+   run exits ``SENTINEL_EXIT_CODE`` (73) — elastic policy: restart at
+   the same shape.
+
+Default path invariant: with ``--sentinel_interval=0`` (the default)
+the driver never constructs this class and no jitted program changes —
+the PR 13 golden losses stay bit-exact.
+
+Chaos points (runtime/faults.py): ``param_bitflip`` flips a mantissa
+bit in a param leaf right after an audited update (the delta arm must
+catch it), ``kernel_miscompute`` scales the audited hot grads 2x (the
+gradient arm must breach; rung 1 clears it), ``replica_diverge``
+corrupts this process's fingerprint before the compare.
+"""
+
+import dataclasses
+import logging
+from typing import Any, Callable, Optional, Tuple
+
+from scalable_agent_tpu.obs import get_flight_recorder, get_registry
+from scalable_agent_tpu.obs.device_telemetry import (
+    DeviceTelemetry,
+    TelemetryPublisher,
+    fetch_merged,
+    merge_init,
+)
+from scalable_agent_tpu.runtime.exit_codes import SENTINEL_EXIT_CODE
+from scalable_agent_tpu.runtime.faults import get_fault_injector
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "LADDER",
+    "NumericsSentinel",
+    "sentinel_telemetry_spec",
+]
+
+# The degradation ladder, least-drastic first.  Rung r applies the
+# cumulative union of the first r entries to the run's config and
+# rebuilds the learner; the *reference* path is the union of all three
+# (what the shadow audit always computes against).  Ordering follows
+# blast radius: the Pallas stem kernel is the newest/riskiest arm, the
+# precision demotion costs the most throughput, and dropping the fused
+# loss only de-optimizes scheduling.
+LADDER = (
+    {"conv_backend": "xla"},
+    {"compute_dtype": "float32", "core_matmul_dtype": "float32"},
+    {"fused_forward": False},
+)
+
+# XOR'd into this process's fingerprint by the ``replica_diverge``
+# chaos point — any nonzero constant proves the compare.
+_DIVERGE_MASK = 0xDEADBEEF
+
+
+def sentinel_telemetry_spec() -> DeviceTelemetry:
+    """Device-side sentinel telemetry: audit count, breach count, and
+    the last audit's max relative deviation (the calibration signal —
+    watch it approach ``--sentinel_rtol`` before a trip)."""
+    return (
+        DeviceTelemetry("sentinel")
+        .counter("audits", "shadow audits run on device")
+        .counter("breaches", "audits whose max deviation exceeded rtol")
+        .gauge("max_deviation",
+               "last audit's max relative grad/delta deviation")
+    )
+
+
+def _reference_config(config):
+    """The run config with every ladder rung applied: XLA stem, f32
+    compute, two-pass loss — the trusted arm audits compare against."""
+    overrides = {}
+    for rung in LADDER:
+        overrides.update(rung)
+    return dataclasses.replace(config, **overrides)
+
+
+class NumericsSentinel:
+    """Shadow audits + fingerprints + the degradation ladder.
+
+    ``rebuild(config) -> (agent, learner)`` is the driver's factory
+    closure; the sentinel uses it once (lazily) for the reference
+    learner and once per demotion rung for the replacement hot learner.
+    The driver polls :meth:`consume_swap` after each audit and, when it
+    returns True, adopts :attr:`learner`/:attr:`agent` (one re-jit on
+    the next update), republishes params to actors, and flushes the
+    replay slab (suspect lineage).
+    """
+
+    def __init__(self, config, agent, learner,
+                 rebuild: Callable[[Any], Tuple[Any, Any]],
+                 registry=None):
+        if config.sentinel_interval <= 0:
+            raise ValueError(
+                "NumericsSentinel requires --sentinel_interval > 0; "
+                "the driver must not construct it for sentinel-off runs")
+        registry = registry or get_registry()
+        self._base_config = config
+        self._rebuild = rebuild
+        self._hot_agent = agent
+        self._hot = learner
+        self._interval = int(config.sentinel_interval)
+        self._rtol = float(config.sentinel_rtol)
+        # Lazily built: the reference learner re-jits its own loss the
+        # first time an audit runs, not at startup.
+        self._ref: Optional[Any] = None
+        self._rung = 0
+        self._audit_fn = None       # re-jitted per rung
+        self._checksum_fn = None
+        self._flip_fn = None
+        self._swapped = False       # one-shot: driver consumes
+        self._rolled_back = False   # a sentinel rollback already spent
+        self.rollback_pending = False
+
+        self._spec = sentinel_telemetry_spec()
+        self._devtel = learner._place_replicated(merge_init([self._spec]))
+        self._publisher = TelemetryPublisher([self._spec],
+                                             registry=registry)
+
+        self._c_trips = registry.counter(
+            "sentinel/trips_total",
+            "sentinel breaches (audit or fingerprint mismatch)")
+        self._c_demotions = registry.counter(
+            "sentinel/demotions_total",
+            "degradation-ladder rungs taken (re-jits of the hot path)")
+        self._c_fp_mismatch = registry.counter(
+            "sentinel/fingerprint_mismatch_total",
+            "cross-process param-fingerprint disagreements")
+        self._g_rung = registry.gauge(
+            "sentinel/rung",
+            "current degradation-ladder rung (0 = full hot path)")
+        self._g_fingerprint = registry.gauge(
+            "sentinel/param_fingerprint",
+            "this process's uint32 param-tree checksum")
+        self._g_rung.set(0)
+
+    # ------------------------------------------------------------------
+    # Introspection the driver wires against.
+
+    @property
+    def learner(self):
+        """The current hot learner (changes after a demotion)."""
+        return self._hot
+
+    @property
+    def agent(self):
+        """The agent paired with :attr:`learner`."""
+        return self._hot_agent
+
+    @property
+    def rung(self) -> int:
+        return self._rung
+
+    def audit_due(self, updates: int) -> bool:
+        """True when the update about to run (0-based counter) should
+        be audited: the driver snapshots state+batch before it."""
+        return (updates + 1) % self._interval == 0
+
+    def consume_swap(self) -> bool:
+        """True exactly once after a demotion — the driver adopts the
+        new learner/agent and re-places state."""
+        swapped, self._swapped = self._swapped, False
+        return swapped
+
+    def note_rollback(self):
+        """The driver completed a sentinel-requested rollback; the next
+        surviving breach exits 73 instead of rolling back again."""
+        self.rollback_pending = False
+        self._rolled_back = True
+
+    # ------------------------------------------------------------------
+    # Snapshots.
+
+    def snapshot(self, state):
+        """Copy the TrainState into distinct device buffers — the hot
+        update donates its input, so the audit needs its own copy."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.copy, state)
+
+    # ------------------------------------------------------------------
+    # Shadow audit.
+
+    def _ensure_ref(self):
+        if self._ref is None:
+            ref_config = _reference_config(self._base_config)
+            _, self._ref = self._rebuild(ref_config)
+        return self._ref
+
+    def _build_audit(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        hot, ref = self._hot, self._ensure_ref()
+        spec, rtol = self._spec, self._rtol
+
+        def leaf_dev(h, r):
+            # Per-leaf L2-relative deviation, NOT max-element: a single
+            # near-cancelled element can read 45% off in clean bf16
+            # (measured, bench_sentinel at production shapes) while the
+            # leaf as a whole agrees to ~1%.  The faults this arm
+            # exists for move the whole leaf (a 2x-scaled kernel reads
+            # exactly 1.0 here) or one LARGE element (a bit-flip dwarfs
+            # the reference delta's norm), so the norm keeps them loud
+            # and the rounding noise quiet.
+            h32 = jnp.asarray(h, jnp.float32)
+            r32 = jnp.asarray(r, jnp.float32)
+            dev = (jnp.linalg.norm(h32.ravel() - r32.ravel())
+                   / (jnp.linalg.norm(r32.ravel()) + 1e-6))
+            # NaN batches are the non-finite guard's domain; the
+            # sentinel must stay quiet on them, not double-report.
+            return jnp.where(jnp.isfinite(dev), dev, 0.0)
+
+        def tree_max(tree_a, tree_b):
+            devs = jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(leaf_dev, tree_a, tree_b))
+            return jnp.max(jnp.stack(devs)) if devs else jnp.float32(0.0)
+
+        def audit(snap, trajectory, params_after, miscompute, devtel):
+            # Arm 1: gradients, hot vs reference, same batch and params.
+            (_, _), hot_grads = jax.value_and_grad(
+                hot._loss, has_aux=True)(
+                    snap.params, trajectory, snap.target_params)
+            hot_grads = jax.tree_util.tree_map(
+                lambda g: g * (1.0 + miscompute), hot_grads)
+            (_, _), ref_grads = jax.value_and_grad(
+                ref._loss, has_aux=True)(
+                    snap.params, trajectory, snap.target_params)
+            grad_dev = tree_max(hot_grads, ref_grads)
+
+            # Arm 2: the applied param delta vs the reference delta from
+            # the same optimizer state.  Catches corruption downstream
+            # of the gradients (optimizer math, the apply, SDC in the
+            # written slab).
+            lr = ref._hp.learning_rate * jnp.maximum(
+                0.0, 1.0 - snap.env_frames
+                / ref._hp.total_environment_frames)
+            updates, _ = ref._tx.update(
+                ref_grads, snap.opt_state, snap.params)
+            updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+            ref_after = optax.apply_updates(snap.params, updates)
+
+            def delta(a, b):
+                return (jnp.asarray(a, jnp.float32)
+                        - jnp.asarray(b, jnp.float32))
+
+            hot_delta = jax.tree_util.tree_map(
+                delta, params_after, snap.params)
+            ref_delta = jax.tree_util.tree_map(
+                delta, ref_after, snap.params)
+            # The finite guard may have skipped the hot update (params
+            # unchanged); a zero delta against a nonzero reference
+            # delta is the guard doing its job, not corruption.
+            applied = jnp.any(jnp.stack([
+                jnp.any(jnp.abs(d) > 0)
+                for d in jax.tree_util.tree_leaves(hot_delta)]))
+            delta_dev = jnp.where(
+                applied, tree_max(hot_delta, ref_delta), 0.0)
+
+            max_dev = jnp.maximum(grad_dev, delta_dev)
+            breach = max_dev > rtol
+            devtel = spec.inc(devtel, "audits")
+            devtel = spec.inc(devtel, "breaches",
+                              breach.astype(jnp.float32))
+            devtel = spec.set(devtel, "max_deviation", max_dev)
+            return devtel, breach, max_dev
+
+        return jax.jit(audit, donate_argnums=(4,))
+
+    def _build_flip(self):
+        import jax
+        import jax.numpy as jnp
+
+        def flip(params):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            flat = jnp.concatenate([
+                jnp.asarray(leaf, jnp.float32).ravel()
+                for leaf in leaves])
+            # Flip bit 20 of the f32 mantissa (~2^-3 = 12.5% relative)
+            # in the LARGEST-magnitude element: guaranteed nonzero (a
+            # zero-initialized bias bit-flips to a denormal — invisible
+            # to any tolerance), far outside rtol, far inside overflow.
+            idx = jnp.argmax(jnp.abs(flat))
+            bits = jax.lax.bitcast_convert_type(
+                flat[idx], jnp.uint32) ^ jnp.uint32(1 << 20)
+            flat = flat.at[idx].set(
+                jax.lax.bitcast_convert_type(bits, jnp.float32))
+            out, offset = [], 0
+            for leaf in leaves:
+                segment = flat[offset:offset + leaf.size]
+                out.append(segment.reshape(leaf.shape).astype(leaf.dtype))
+                offset += leaf.size
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return jax.jit(flip)
+
+    def _fetch_scalar(self, x):
+        import numpy as np
+
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        return np.asarray(x.addressable_shards[0].data)
+
+    def audit(self, snap, trajectory, state, updates: int):
+        """Run one shadow audit.  ``snap`` is the pre-update snapshot,
+        ``state`` the post-update TrainState.  Returns the (possibly
+        chaos-corrupted) state; breach handling may demote the ladder,
+        set :attr:`rollback_pending`, or exit 73."""
+        import numpy as np
+
+        injector = get_fault_injector()
+        miscompute = 0.0
+        if injector.active:
+            # The miscomputing-kernel stand-in only exists while the
+            # suspect kernel is still in the hot path (rung 0): the
+            # first demotion replaces it, so post-demotion audits run
+            # clean and the chaos run finishes — detect→demote→finish.
+            if self._rung == 0 and injector.should_fire(
+                    "kernel_miscompute"):
+                miscompute = 1.0
+            if injector.should_fire("param_bitflip"):
+                if self._flip_fn is None:
+                    self._flip_fn = self._build_flip()
+                state = state._replace(
+                    params=self._flip_fn(state.params))
+
+        if self._audit_fn is None:
+            self._audit_fn = self._build_audit()
+        self._devtel, breach, max_dev = self._audit_fn(
+            snap, trajectory, state.params,
+            np.float32(miscompute), self._devtel)
+        # The one D2H of the audit: a bool and a float, at audit
+        # cadence only.
+        if bool(self._fetch_scalar(breach)):
+            self._on_breach(float(self._fetch_scalar(max_dev)), updates)
+        return state
+
+    def _on_breach(self, max_dev: float, updates: int):
+        recorder = get_flight_recorder()
+        self._c_trips.inc()
+        recorder.record("sentinel_trip", "audit", {
+            "rung": self._rung, "max_deviation": max_dev,
+            "update": updates})
+        if recorder.reason_pin is None:
+            recorder.reason_pin = "sentinel_trip:audit"
+        if self._rung < len(LADDER):
+            self._demote(max_dev, updates)
+        elif not self._rolled_back:
+            log.error(
+                "sentinel: breach (max_dev=%.3g) survived the full "
+                "degradation ladder at update %d — rolling back to the "
+                "newest verified checkpoint", max_dev, updates)
+            self.rollback_pending = True
+        else:
+            recorder.record("sentinel_trip", "exhausted", {
+                "max_deviation": max_dev, "update": updates})
+            recorder.dump_all("sentinel:exhausted")
+            log.error(
+                "sentinel: breach persists after full demotion AND a "
+                "rollback — the reference path cannot be reproduced on "
+                "this hardware; exiting %d", SENTINEL_EXIT_CODE)
+            raise SystemExit(SENTINEL_EXIT_CODE)
+
+    def _demote(self, max_dev: float, updates: int):
+        self._rung += 1
+        overrides = {}
+        for rung_overrides in LADDER[:self._rung]:
+            overrides.update(rung_overrides)
+        demoted = dataclasses.replace(self._base_config, **overrides)
+        self._hot_agent, self._hot = self._rebuild(demoted)
+        self._audit_fn = None  # re-jit against the new hot path
+        self._swapped = True
+        self._c_demotions.inc()
+        self._g_rung.set(self._rung)
+        get_flight_recorder().record("sentinel_trip", "demote", {
+            "rung": self._rung, "overrides": dict(overrides)})
+        log.warning(
+            "sentinel: audit breach (max_dev=%.3g > rtol=%.3g) at "
+            "update %d — demoting to rung %d (%s)",
+            max_dev, self._rtol, updates, self._rung,
+            ", ".join(f"{k}={v}" for k, v in overrides.items()))
+
+    # ------------------------------------------------------------------
+    # Param fingerprints.
+
+    def _build_checksum(self):
+        import jax
+        import jax.numpy as jnp
+
+        def checksum(params):
+            total = jnp.zeros((), jnp.uint32)
+            for leaf in jax.tree_util.tree_leaves(params):
+                bits = jax.lax.bitcast_convert_type(
+                    jnp.asarray(leaf, jnp.float32).ravel(), jnp.uint32)
+                # uint32 wrap-around sum: order-stable, collision odds
+                # irrelevant here (we compare replicas of the SAME
+                # tree, not arbitrary trees).
+                total = total + jnp.sum(bits, dtype=jnp.uint32)
+            return total
+
+        return jax.jit(checksum)
+
+    def local_fingerprint(self, params) -> int:
+        """This process's uint32 checksum of the param tree (one small
+        D2H).  Published as ``sentinel/param_fingerprint``."""
+        if self._checksum_fn is None:
+            self._checksum_fn = self._build_checksum()
+        fp = int(self._fetch_scalar(self._checksum_fn(params)))
+        injector = get_fault_injector()
+        if injector.active and injector.should_fire("replica_diverge"):
+            fp ^= _DIVERGE_MASK
+        self._g_fingerprint.set(fp)
+        return fp
+
+    def check_fingerprints(self, fingerprints) -> bool:
+        """True when the gathered per-process fingerprints disagree —
+        SDC or a divergent replica.  Every process sees the same
+        gathered set, so every process reaches the same verdict (the
+        rollback stays SPMD-consistent without another broadcast)."""
+        import numpy as np
+
+        distinct = {int(f) for f in np.asarray(fingerprints).ravel()}
+        if len(distinct) <= 1:
+            return False
+        self._c_fp_mismatch.inc()
+        self._c_trips.inc()
+        recorder = get_flight_recorder()
+        recorder.record("sentinel_trip", "fingerprint", {
+            "fingerprints": sorted(distinct)})
+        if recorder.reason_pin is None:
+            recorder.reason_pin = "sentinel_trip:fingerprint"
+        log.error(
+            "sentinel: param fingerprints disagree across processes "
+            "(%s) — a replica diverged; rolling back",
+            sorted(distinct))
+        return True
+
+    # ------------------------------------------------------------------
+    # Telemetry.
+
+    def publish(self):
+        """Fetch+publish the devtel block (driver log cadence)."""
+        fetched = fetch_merged([self._spec], self._devtel)
+        if fetched is not None:
+            self._publisher.publish(fetched)
+        return fetched

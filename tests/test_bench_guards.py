@@ -1164,3 +1164,74 @@ class TestLearningRegressionGuard:
         for key in ("learning_rho_clip_fraction", "learning_ess_frac",
                     "learning_entropy_frac"):
             assert 0.0 <= diag[key] <= 1.0, key
+
+
+class TestSentinelRegressionGuard:
+    """ISSUE 19 satellite: the shadow-audit budget guard (<1% of the
+    update stage amortized at K=512) fails on TPU, warns on the CPU
+    fallback, and — obs-guard-style — errors when a sentinel key the
+    previous round published goes missing."""
+
+    def _diag(self, platform="tpu", **kwargs):
+        diag = {"errors": [], "platform": platform,
+                "sentinel_audit_sec": 8.0,
+                "sentinel_sec_per_update": 2.0}
+        diag.update(kwargs)
+        return diag
+
+    def _write_prev(self, tmp_path, platform="tpu", **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": platform, **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_over_budget_fails_on_tpu(self):
+        diag = self._diag(sentinel_frac_on_update=0.02)
+        bench.sentinel_regression_guard(diag)
+        assert any("SENTINEL" in e for e in diag["errors"])
+
+    def test_over_budget_warns_on_cpu_fallback(self):
+        diag = self._diag(platform="cpu",
+                          sentinel_frac_on_update=0.02)
+        bench.sentinel_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("SENTINEL" in w for w in diag["warnings"])
+
+    def test_under_budget_is_silent(self):
+        diag = self._diag(sentinel_frac_on_update=0.008)
+        bench.sentinel_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.sentinel_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, sentinel_frac_on_update=0.008,
+            sentinel_fingerprint_us=5.0)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.sentinel_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "SENTINEL REGRESSION" in e and "missing" in e]
+        assert len(missing) == 2
+
+    def test_parity_with_previous_round_is_silent(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, sentinel_frac_on_update=0.008,
+            sentinel_fingerprint_us=5.0, sentinel_rejit_s=12.0)
+        diag = self._diag(sentinel_frac_on_update=0.007,
+                          sentinel_fingerprint_us=6.0,
+                          sentinel_rejit_s=11.0)
+        bench.sentinel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, platform="tpu", sentinel_frac_on_update=0.008)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.sentinel_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []

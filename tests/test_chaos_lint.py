@@ -1,0 +1,163 @@
+"""Static guard: every chaos point stays wired, documented, and tested.
+
+ISSUE 4 built the deterministic fault injector; since then every
+robustness PR has added points (``runtime/faults.py`` CHAOS_POINTS is
+at 14).  Completeness was enforced by review — this test enforces it by
+CONSTRUCTION, the same shape as ``test_collective_lint.py``: it walks
+the package ASTs for injector call sites (``should_fire`` /
+``maybe_raise`` / ``occurrences`` with a string-literal point name) and
+fails when
+
+1. a call site names a point the registry doesn't know (a typo'd point
+   silently never fires — the injection would be dead code),
+2. a registered point has NO call site (a matrix row that injects
+   nothing),
+3. a registered point is missing from the ``docs/robustness.md`` fault
+   matrix (operators grep that table first), or
+4. a registered point is exercised by no test under ``tests/`` (an
+   untested injection rots exactly like untested code).
+
+Points justifiably exempt from one of the checks must be listed in the
+matching allowlist WITH the justification — and stale entries fail too,
+so the lists can only shrink.
+"""
+
+import ast
+import os
+import re
+
+import scalable_agent_tpu
+from scalable_agent_tpu.runtime.faults import CHAOS_POINTS
+
+PKG_DIR = os.path.dirname(os.path.abspath(scalable_agent_tpu.__file__))
+REPO_DIR = os.path.dirname(PKG_DIR)
+TESTS_DIR = os.path.join(REPO_DIR, "tests")
+ROBUSTNESS_MD = os.path.join(REPO_DIR, "docs", "robustness.md")
+
+# The injector surface: a string literal as the first argument to any
+# of these names is a chaos-point reference.
+INJECTOR_CALLS = {"should_fire", "maybe_raise", "occurrences"}
+
+# Points with no source call site, with justification.  (Empty today —
+# every registered point is wired.)
+UNWIRED_ALLOWLIST = set()
+
+# Points allowed to be absent from the docs fault matrix.  (Empty —
+# the matrix is the operator-facing contract.)
+UNDOCUMENTED_ALLOWLIST = set()
+
+# Points allowed to have no exercising test.  (Empty — every point is
+# driven by at least one chaos test.)
+UNTESTED_ALLOWLIST = set()
+
+
+def _package_files():
+    for dirpath, dirnames, filenames in os.walk(PKG_DIR):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def collect_call_sites():
+    """{point: [(relpath, lineno), ...]} for every injector call site
+    in the package whose point argument is a string literal."""
+    sites = {}
+    for path in _package_files():
+        rel = os.path.relpath(path, PKG_DIR)
+        if rel == os.path.join("runtime", "faults.py"):
+            continue  # the registry itself, not a wiring site
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+            if name not in INJECTOR_CALLS or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                sites.setdefault(arg.value, []).append(
+                    (rel, node.lineno))
+    return sites
+
+
+def _tests_referencing(point):
+    pattern = re.compile(r"\b" + re.escape(point) + r"\b")
+    hits = []
+    for name in sorted(os.listdir(TESTS_DIR)):
+        if not name.endswith(".py") or name == os.path.basename(__file__):
+            continue
+        if pattern.search(open(os.path.join(TESTS_DIR, name)).read()):
+            hits.append(name)
+    return hits
+
+
+def test_every_call_site_names_a_registered_point():
+    sites = collect_call_sites()
+    unknown = {point: locs for point, locs in sites.items()
+               if point not in CHAOS_POINTS}
+    assert not unknown, (
+        "injector call sites naming UNREGISTERED chaos points (a typo "
+        "here silently never fires — register the point in "
+        f"runtime/faults.py CHAOS_POINTS or fix the name): {unknown}")
+
+
+def test_every_registered_point_is_wired():
+    sites = collect_call_sites()
+    unwired = set(CHAOS_POINTS) - set(sites) - UNWIRED_ALLOWLIST
+    assert not unwired, (
+        "CHAOS_POINTS entries with no should_fire/maybe_raise/"
+        "occurrences call site in the package (the matrix row injects "
+        f"nothing): {sorted(unwired)}")
+
+
+def test_every_registered_point_is_in_the_docs_fault_matrix():
+    text = open(ROBUSTNESS_MD).read()
+    missing = {point for point in CHAOS_POINTS
+               if f"`{point}`" not in text} - UNDOCUMENTED_ALLOWLIST
+    assert not missing, (
+        "chaos points missing from the docs/robustness.md fault matrix "
+        f"(operators grep that table first): {sorted(missing)}")
+
+
+def test_every_registered_point_is_exercised_by_a_test():
+    untested = {point for point in CHAOS_POINTS
+                if not _tests_referencing(point)} - UNTESTED_ALLOWLIST
+    assert not untested, (
+        "chaos points exercised by no test under tests/ (untested "
+        f"injection rots like untested code): {sorted(untested)}")
+
+
+def test_allowlists_have_no_stale_entries():
+    sites = collect_call_sites()
+    stale = {
+        "UNWIRED_ALLOWLIST":
+            {p for p in UNWIRED_ALLOWLIST if p in sites},
+        "UNDOCUMENTED_ALLOWLIST":
+            {p for p in UNDOCUMENTED_ALLOWLIST
+             if f"`{p}`" in open(ROBUSTNESS_MD).read()},
+        "UNTESTED_ALLOWLIST":
+            {p for p in UNTESTED_ALLOWLIST if _tests_referencing(p)},
+    }
+    stale = {k: sorted(v) for k, v in stale.items() if v}
+    assert not stale, (
+        f"allowlist entries whose exemption no longer holds (delete "
+        f"them — the lists only shrink): {stale}")
+
+
+def test_lint_actually_sees_the_known_sites():
+    """The walker must FIND the known wiring (an AST bug that collects
+    nothing would green-light everything)."""
+    sites = collect_call_sites()
+    assert "nan_grad" in sites and len(sites["nan_grad"]) >= 2
+    assert any(rel == os.path.join("runtime", "sentinel.py")
+               for rel, _ in sites.get("param_bitflip", []))
+    assert any(rel == os.path.join("runtime", "sentinel.py")
+               for rel, _ in sites.get("kernel_miscompute", []))
+    assert any(rel == os.path.join("runtime", "sentinel.py")
+               for rel, _ in sites.get("replica_diverge", []))
+    assert any(rel == "driver.py"
+               for rel, _ in sites.get("throughput_sag", []))
