@@ -320,10 +320,26 @@ class Config:
     # recorder).  0 restores fail-fast.
     actor_max_restarts: int = 3
     # Deterministic fault injection (runtime/faults.py), chaos testing
-    # only: 'point@i[:j...]' entries joined by ';', e.g.
-    # 'nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20'.
+    # only: 'point@i[:j...]' / 'point@t=30s' / 'point@p=0.01' entries
+    # joined by ';', e.g.
+    # 'nan_grad@7;actor_raise@3:12;ckpt_torn@t=5s;worker_kill@p=0.01'.
     # Empty = no faults.
     chaos_spec: str = ""
+    # Arm the runtime injection channel: the injector tails
+    # <logdir>/chaos_inject.jsonl and fires each appended
+    # {"point": ..., "t_unix": ...} line once at that point's next
+    # evaluation — faults land in an ALREADY-RUNNING fleet (the chaos
+    # soak engine, runtime/soak.py, writes the lines).  Propagates to
+    # relaunched elastic workers like any other flag.
+    chaos_channel: bool = False
+    # JAX persistent compilation cache directory ('' = disabled).  MTTR
+    # engineering: an elastic relaunch's recovery time is dominated by
+    # the fresh process's first compile; with the cache armed, epoch 0
+    # populates it and every relaunch (and every restart of the same
+    # config) compiles from disk.  Wired through both driver backends;
+    # safe to share across fleet processes (the cache is keyed by
+    # program fingerprint and written atomically).
+    compile_cache_dir: str = ""
     # -- fleet fault domains (runtime/fleet.py, docs/robustness.md) ------
     # Peer heartbeat deadline: in a multi-process run, a peer whose
     # KV-store heartbeat stops advancing for this long (local monotonic

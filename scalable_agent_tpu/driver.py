@@ -48,7 +48,15 @@ Self-healing flags (docs/robustness.md):
     --actor_max_restarts=K    bounded actor-thread respawn budget with
         capped exponential backoff.
     --chaos_spec='point@i[:j...];...'   deterministic fault injection
-        (runtime/faults.py) for chaos testing the recovery paths.
+        (runtime/faults.py) for chaos testing the recovery paths; also
+        accepts 'point@t=30s' (time trigger) and 'point@p=0.01'
+        (seeded per-evaluation probability) entries.
+    --chaos_channel           tail <logdir>/chaos_inject.jsonl for
+        runtime-injected one-shot faults — the chaos soak engine's
+        (runtime/soak.py) injection path into an already-running run.
+    --compile_cache_dir=DIR   JAX persistent compilation cache: a
+        relaunch/restart of the same program compiles from disk, which
+        is what keeps elastic-reshard MTTR flat (docs/robustness.md).
 
 Fleet fault-domain flags (runtime/fleet.py, docs/robustness.md):
     --peer_timeout_s=T        multi-process peer heartbeat deadline: a
@@ -137,6 +145,7 @@ from scalable_agent_tpu.runtime.exit_codes import (
     SENTINEL_EXIT_CODE,
 )
 from scalable_agent_tpu.runtime.faults import (
+    CHANNEL_NAME,
     get_fault_injector,
     throughput_sag_s,
 )
@@ -945,6 +954,60 @@ def _rollback_or_exit(config: Config, ckpt: CheckpointManager,
     return state, step, frames
 
 
+def _setup_compile_cache(config: Config):
+    """Arm JAX's persistent compilation cache (--compile_cache_dir).
+
+    MTTR engineering (docs/robustness.md): an elastic relaunch pays the
+    fresh process's first compile before its first metrics row, so the
+    epochs-log ``mttr`` is dominated by compile time.  With the cache
+    armed, epoch 0 populates it and every relaunch's compile is a disk
+    read.  The floor knobs are zeroed so even the small CPU test
+    programs cache — the production TPU programs clear any floor."""
+    if not config.compile_cache_dir:
+        return
+    os.makedirs(config.compile_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir",
+                      config.compile_cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def _arm_faults(config: Config):
+    """Arm the chaos injector for this run: the --chaos_spec triggers
+    plus, under --chaos_channel, the <logdir>/chaos_inject.jsonl
+    runtime channel (the soak engine's injection path)."""
+    configure_faults(
+        config.chaos_spec,
+        channel_path=(os.path.join(config.logdir, CHANNEL_NAME)
+                      if config.chaos_channel else None),
+        seed=config.seed,
+        process_id=max(0, config.distributed_process_id))
+
+
+def _write_mttr_breakdown(config: Config, restore_s: float,
+                          compile_s: float):
+    """Publish this process's startup-cost segments for the elastic
+    supervisor's MTTR decomposition (runtime/elastic.py reads the file
+    at the recovery beacon and folds the segments into the epochs-log
+    ``mttr`` record).  Coordinator only; atomic replace."""
+    if jax.process_index() != 0:
+        return
+    from scalable_agent_tpu.runtime.elastic import MTTR_BREAKDOWN_NAME
+
+    payload = {"epoch": int(config.fleet_epoch),
+               "restore_s": round(restore_s, 3),
+               "compile_s": round(compile_s, 3),
+               "t_unix": time.time()}
+    path = os.path.join(config.logdir, MTTR_BREAKDOWN_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        log.exception("mttr breakdown write failed (non-fatal)")
+
+
 def train(config: Config) -> Dict[str, float]:
     """Train until total_environment_frames.  Returns final metrics.
 
@@ -977,10 +1040,12 @@ def train(config: Config) -> Dict[str, float]:
     config = apply_env_overrides(config)
     if is_coordinator():
         config.save()
-    # Chaos harness: arm the deterministic fault-injection points
-    # (no-op with an empty spec); disarmed again in the finally so one
-    # run's spec can't leak into the next in-process run.
-    configure_faults(config.chaos_spec)
+    _setup_compile_cache(config)
+    # Chaos harness: arm the deterministic fault-injection points and
+    # (under --chaos_channel) the runtime injection channel (no-op with
+    # neither configured); disarmed again in the finally so one run's
+    # spec can't leak into the next in-process run.
+    _arm_faults(config)
     # Observability comes up BEFORE the actor pool so its threads are
     # born with the live tracer and watchdog (spans/heartbeats from the
     # very first unroll); the try below owns teardown from this point
@@ -1067,6 +1132,7 @@ def train(config: Config) -> Dict[str, float]:
             # otherwise still be draining when restore()'s has_any
             # broadcast posts its own ops.
             jax.block_until_ready(state)
+        restore_t0 = time.monotonic()
         restored = ckpt.restore(target=state)
         if restored is not None:
             start_updates, host_state = restored
@@ -1086,6 +1152,7 @@ def train(config: Config) -> Dict[str, float]:
                      start_updates, _host_scalar(state.env_frames))
         else:
             start_updates = 0
+        restore_s = time.monotonic() - restore_t0
 
         # Live MFU numerator: lower (don't compile) the update once at
         # the run's REAL [T+1, local_B] shape for its cost-analysis
@@ -1205,6 +1272,14 @@ def train(config: Config) -> Dict[str, float]:
         inflight = InflightWindow(inflight_updates,
                                   registry=registry)
         rollback_wanted = False
+        # Compile windows are recovery/startup cost, not wedges: the
+        # first dispatch (cold or relaunch compile) and the re-jit
+        # after a sentinel ladder demotion (~13s measured) run with the
+        # learner heartbeat suspended — the same treatment rollback
+        # restore gets — so a tight --watchdog_timeout_s doesn't read
+        # them as hangs.  The post-update touch re-arms.
+        rejit_pending = True
+        first_dispatch_t0 = None
         while frames < config.total_environment_frames:
             if (config.profile_dir and not profiling
                     and not health.window_open
@@ -1240,6 +1315,11 @@ def train(config: Config) -> Dict[str, float]:
                 # needs its own buffers (the trajectory is not
                 # donated and rides through as-is).
                 audit_snap = sentinel.snapshot(state)
+            if rejit_pending:
+                watchdog.suspend("learner")
+                rejit_pending = False
+                if updates == start_updates:
+                    first_dispatch_t0 = time.monotonic()
             with timing.time_avg("update"), interval.add_time("update"):
                 state, dispatched = learner.update(state, traj)
                 # Chaos: a deterministic mid-run slowdown (thermal
@@ -1261,6 +1341,15 @@ def train(config: Config) -> Dict[str, float]:
                 # and gloo mispairs anything that arrives alongside it.
                 jax.block_until_ready(state)
             watchdog.touch("learner")
+            if first_dispatch_t0 is not None:
+                # Startup-cost beacon for the supervisor's MTTR
+                # decomposition: the first dispatch blocks through the
+                # update's compile, so its wall time is the compile
+                # segment.
+                _write_mttr_breakdown(config, restore_s,
+                                      time.monotonic()
+                                      - first_dispatch_t0)
+                first_dispatch_t0 = None
             if audit_snap is not None:
                 # Shadow audit: recompute this batch's grads + param
                 # delta through the reference arm on device and compare
@@ -1271,6 +1360,11 @@ def train(config: Config) -> Dict[str, float]:
                 # learner (the prefetch thread keeps the old learner's
                 # transport; its placed trajectories feed the new
                 # learner unchanged — computation follows data).
+                # The reference arm's own compile (first audit) and the
+                # compare are recovery machinery, not progress the
+                # heartbeat should time — suspend like rollback
+                # restore; the touch below re-arms.
+                watchdog.suspend("learner")
                 with timing.time_avg("audit"), \
                         interval.add_time("audit"):
                     state = sentinel.audit(audit_snap, traj, state,
@@ -1286,6 +1380,10 @@ def train(config: Config) -> Dict[str, float]:
                     agent = sentinel.agent
                     if replay is not None:
                         replay.flush()
+                    # The demoted rung re-jits inside the next dispatch
+                    # (~13s measured): suspend across it too.
+                    rejit_pending = True
+                watchdog.touch("learner")
             # The size gate covers the re-warm-up window after a
             # rollback/demotion flush: the slab refills from the
             # prefetch thread's uploads, and until the first lands the
@@ -1385,6 +1483,14 @@ def train(config: Config) -> Dict[str, float]:
                     # the log-time fetch below is the sync the seed
                     # loop always paid here.
                     metrics = dispatched
+                # The log-time fetches (host scalars here, the devtel/
+                # sentinel publishes below) drain the device queue —
+                # which, right after an audit or ladder demotion,
+                # carries the recovery path's compiles.  That wait is
+                # device backlog, not a wedged learner: disarm across
+                # the fetch section; the touch after ledger.publish
+                # re-arms.
+                watchdog.suspend("learner")
                 host_metrics = {k: _host_scalar(v)
                                 for k, v in metrics.items()}
                 # Only RECORD the verdict here: the log gate runs on
@@ -1462,6 +1568,7 @@ def train(config: Config) -> Dict[str, float]:
                 # share (rates/ρ/staleness/MFU land in the registry and
                 # ride the writer/prom dumps below).
                 ledger.publish()
+                watchdog.touch("learner")
                 # Stall attribution over THIS interval's stage sums.
                 interval_summary = interval.summary()
                 interval.clear()
@@ -1891,7 +1998,8 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             "(runtime/sentinel.py)")
     config = apply_env_overrides(config)
     config.save()
-    configure_faults(config.chaos_spec)  # disarmed again in the finally
+    _setup_compile_cache(config)
+    _arm_faults(config)  # disarmed again in the finally
 
     # Probe the HOST twin of the level so action/observation specs stay
     # in lock-step with the device env.  For the fake family the twin
@@ -1939,6 +2047,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
 
     ckpt = CheckpointManager(config.logdir, config.checkpoint_interval_s,
                              config.checkpoint_keep)
+    restore_t0 = time.monotonic()
     restored = ckpt.restore(target=state)
     if restored is not None:
         start_updates, host_state = restored
@@ -1953,6 +2062,7 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                  start_updates, _host_scalar(state.env_frames))
     else:
         start_updates = 0
+    restore_s = time.monotonic() - restore_t0
 
     timing = Timing()
     updates = start_updates
@@ -2023,6 +2133,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
             # Updates dispatched but not yet known-materialized: their
             # ledger records retire together at the next metrics fetch.
             pending_tids: List[int] = []
+            # Same compile-window discipline as the host backend: the
+            # first dispatch and the post-demotion trainer re-jit run
+            # with the learner heartbeat suspended.
+            rejit_pending = True
+            first_dispatch_t0 = None
             while frames < config.total_environment_frames:
                 if (config.profile_dir and not profiling
                         and not health.window_open
@@ -2041,6 +2156,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                     profile_stop_at = updates + config.profile_num_updates
                 ledger_tid = ledger.open("ingraph",
                                          config.level_name)
+                if rejit_pending:
+                    watchdog.suspend("learner")
+                    rejit_pending = False
+                    if updates == start_updates:
+                        first_dispatch_t0 = time.monotonic()
                 with timing.time_avg("update"), \
                         get_tracer().span("learner/train_step",
                                           cat="learner"):
@@ -2065,6 +2185,14 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                                                np.int32(updates)))
                 ledger.stamp(ledger_tid, "dispatch")
                 pending_tids.append(ledger_tid)
+                if first_dispatch_t0 is not None:
+                    # Startup-cost beacon for the supervisor's MTTR
+                    # decomposition (the first dispatch blocks through
+                    # the megaloop's compile).
+                    _write_mttr_breakdown(config, restore_s,
+                                          time.monotonic()
+                                          - first_dispatch_t0)
+                    first_dispatch_t0 = None
                 # Chaos: the same deterministic mid-run slowdown as the
                 # host backend (occurrences count dispatches), timed as
                 # update work so the interval's fps sag is attributable.
@@ -2075,7 +2203,11 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                 if audit_snap is not None:
                     # Shadow audit on the dispatch's emitted trajectory
                     # (same batch the fused update trained on), before
-                    # any replay updates move the params.
+                    # any replay updates move the params.  The
+                    # reference arm's own compile (first audit) is
+                    # recovery machinery — heartbeat suspended, same as
+                    # rollback restore; the touch below re-arms.
+                    watchdog.suspend("learner")
                     with timing.time_avg("audit"):
                         state = sentinel.audit(audit_snap, fresh_traj,
                                                state, updates)
@@ -2099,6 +2231,10 @@ def train_ingraph(config: Config) -> Dict[str, float]:
                             updates_per_dispatch=updates_per_dispatch)
                         if replay is not None:
                             replay.flush()
+                        # The rebuilt trainer re-jits at the next
+                        # dispatch (~13s measured) — suspend across it.
+                        rejit_pending = True
+                    watchdog.touch("learner")
                 if sentinel is not None and sentinel.rollback_pending:
                     # A breach survived the full degradation ladder:
                     # roll back to the newest verified checkpoint (or

@@ -276,9 +276,10 @@ def _fleet_fold(family: str, metric: str, kind: str,
     # Elastic membership (runtime/elastic.py): the epoch gauge is a
     # fleet-wide cursor — mid-relaunch, a straggler's stale snapshot
     # still shows the OLD epoch, and summing epochs is meaningless;
-    # the newest (max) epoch is the membership truth.  MTTR likewise
-    # reports the worst (max) observed recovery.
-    if "fleet_epoch" in metric or metric.endswith("fleet_mttr_s"):
+    # the newest (max) epoch is the membership truth.  MTTR (and its
+    # compile segment, fleet_mttr_compile_s) likewise reports the
+    # worst (max) observed recovery.
+    if "fleet_epoch" in metric or "fleet_mttr" in metric:
         return "max"
     # The IMPACT anchor cadence (runtime/learner.py) is one config
     # value replicated on every process — summing it would inflate the

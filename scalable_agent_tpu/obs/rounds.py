@@ -528,6 +528,12 @@ TRAJECTORY_METRICS: Tuple[Tuple[str, str, str], ...] = (
      "sentinel audit share of update (K=512)", "frac"),
     ("sentinel_fingerprint_us", "sentinel fingerprint cost", "us"),
     ("sentinel_rejit_s", "sentinel ladder re-jit latency", "s"),
+    ("soak_pass", "chaos soak invariants (1=all held)", "bool"),
+    ("soak_throughput_floor_frac",
+     "soak worst healthy-window fps vs baseline", "frac"),
+    ("elastic_mttr_cold_s", "reshard MTTR cache-cold", "s"),
+    ("elastic_mttr_warm_s", "reshard MTTR cache-warm", "s"),
+    ("elastic_mttr_cold_vs_warm", "reshard MTTR cold vs warm", "x"),
 )
 
 
@@ -575,6 +581,12 @@ R06_TARGETS: Tuple[AcceptanceTarget, ...] = (
     AcceptanceTarget(
         "learner_mfu", "mfu", ">=", 0.40,
         "learner update MFU >= 0.40 at B=32", "item 3"),
+    AcceptanceTarget(
+        "chaos_soak", "soak_pass", ">=", 1.0,
+        "the seeded chaos soak (bench_soak / runtime.soak) holds "
+        "every SLO invariant: throughput floor, MTTR ceiling, exact "
+        "frame accounting, verified final checkpoint, quiet outside "
+        "injected windows", "item 3"),
 )
 
 

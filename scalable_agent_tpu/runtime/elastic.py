@@ -28,7 +28,11 @@ in PAPERS.md):
   re-proves the per-leaf CRCs after the state reshards over the new,
   smaller mesh).  ``fleet/resize_total`` counts membership-size
   changes; MTTR (first observed worker death -> first post-reshard
-  metrics row) lands in ``fleet/mttr_s`` and ``fleet_epochs.jsonl``.
+  metrics row) lands in ``fleet/mttr_s`` and ``fleet_epochs.jsonl``,
+  decomposed into detect/relaunch/compile/restore segments via the
+  driver's ``mttr_breakdown.json`` startup beacon (the compile
+  segment also lands in ``fleet/mttr_compile_s``; arm
+  ``--compile_cache_dir`` to flatten it).
 
 - **Rejoin.**  When the lost host comes back (locally:
   ``--elastic_rejoin_delay_s`` elapsed, or an operator touched
@@ -95,6 +99,14 @@ __all__ = [
 
 EPOCHS_LOG_NAME = "fleet_epochs.jsonl"
 SUPERVISOR_PROM_NAME = "metrics.supervisor.prom"
+# The driver's startup-cost beacon (driver._write_mttr_breakdown):
+# {"epoch": E, "restore_s": ..., "compile_s": ...} written atomically
+# by the relaunched coordinator after its first dispatch.  The
+# supervisor joins it (epoch-matched) into the epochs-log ``mttr``
+# record so the recovery time decomposes into detect / relaunch /
+# compile / restore segments — the evidence behind the
+# --compile_cache_dir MTTR engineering (docs/robustness.md).
+MTTR_BREAKDOWN_NAME = "mttr_breakdown.json"
 
 # Exit-code policy (the supervisor side of runtime/exit_codes.py).
 RESHARDABLE = "reshardable"   # relaunch; the slot survives
@@ -258,6 +270,11 @@ class ElasticSupervisor:
             "fleet/mttr_s",
             "last reshard's mean-time-to-recover: first observed "
             "worker death to the first post-reshard metrics row")
+        self._mttr_compile_gauge = registry.gauge(
+            "fleet/mttr_compile_s",
+            "compile segment of the last reshard's MTTR (the relaunched "
+            "coordinator's first dispatch) — near-zero when "
+            "--compile_cache_dir turns it into a disk read")
         self._restarts = registry.counter(
             "fleet/supervisor_restarts_total",
             "fleet relaunches after a non-clean epoch exit")
@@ -393,7 +410,50 @@ class ElasticSupervisor:
 
         return uninstall
 
-    def _watch(self, workers, mttr_anchor: Optional[float]):
+    def _read_mttr_breakdown(self) -> dict:
+        """The current epoch's startup-cost beacon
+        (``MTTR_BREAKDOWN_NAME``), or {} when absent, unparseable, or
+        written by a different epoch (an old driver, or a beacon the
+        relaunch hasn't reached yet)."""
+        try:
+            payload = json.load(open(
+                os.path.join(self.logdir, MTTR_BREAKDOWN_NAME)))
+        except (OSError, json.JSONDecodeError, ValueError):
+            return {}
+        if not isinstance(payload, dict) \
+                or payload.get("epoch") != self.epoch:
+            return {}
+        return payload
+
+    def _mttr_segments(self, mttr_s: float,
+                       mttr_anchor: Optional[float],
+                       launched_at: Optional[float]) -> dict:
+        """Decompose a measured MTTR into detect / relaunch / compile /
+        restore segments: detect = death -> relaunch (supervisor
+        detection, epoch drain, backoff), restore/compile from the
+        driver's startup beacon, relaunch = the remainder (spawn, jax
+        and env construction, first-row wait).  Segments that cannot
+        be attributed are omitted — the record stays honest when the
+        relaunched driver predates the beacon."""
+        segments = {}
+        if mttr_anchor is not None and launched_at is not None:
+            segments["detect_s"] = round(
+                max(0.0, launched_at - mttr_anchor), 3)
+        breakdown = self._read_mttr_breakdown()
+        for key in ("restore_s", "compile_s"):
+            value = breakdown.get(key)
+            if isinstance(value, (int, float)):
+                segments[key] = round(float(value), 3)
+        if "compile_s" in segments:
+            self._mttr_compile_gauge.set(segments["compile_s"])
+        if {"detect_s", "restore_s", "compile_s"} <= set(segments):
+            segments["relaunch_s"] = round(
+                max(0.0, mttr_s - segments["detect_s"]
+                    - segments["restore_s"] - segments["compile_s"]), 3)
+        return segments
+
+    def _watch(self, workers, mttr_anchor: Optional[float],
+               launched_at: Optional[float] = None):
         """Poll one epoch's fleet to completion.  Returns
         ``(codes, drained_for_scale_up, first_death_at)``."""
         jsonl_path = os.path.join(self.logdir, "metrics.jsonl")
@@ -412,9 +472,13 @@ class ElasticSupervisor:
             if mttr_s is not None:
                 self._last_mttr_s = mttr_s
                 self._mttr_gauge.set(mttr_s)
-                self._record("mttr", mttr_s=round(mttr_s, 3))
+                segments = self._mttr_segments(mttr_s, mttr_anchor,
+                                               launched_at)
+                self._record("mttr", mttr_s=round(mttr_s, 3),
+                             **segments)
                 log.info("elastic: reshard MTTR %.1fs (kill -> first "
-                         "post-reshard metrics row)", mttr_s)
+                         "post-reshard metrics row) %s", mttr_s,
+                         {k: v for k, v in segments.items()})
                 mttr_anchor = None
             if first_death_at is None and any(
                     c is not None for c in codes):
@@ -506,7 +570,7 @@ class ElasticSupervisor:
                      self.epoch, n, slots)
 
             codes, scale_up, first_death_at = self._watch(
-                workers, mttr_anchor)
+                workers, mttr_anchor, launched_at=epoch_started)
             mttr_anchor = None
             ran_s = self._clock() - epoch_started
             if ran_s >= self._stable_s:

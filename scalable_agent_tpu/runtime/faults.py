@@ -59,40 +59,71 @@ The three fleet points are armed per-process (each process parses its
 OWN ``--chaos_spec``), so a multi-process soak arms them on exactly one
 peer and asserts the OTHERS' behavior.
 
-The ``--chaos_spec`` grammar is ``point@i[:j:k...]`` entries joined by
-``;``: each integer is a 1-based *occurrence index* of that injection
-point (its Nth evaluation fires).  Example::
+The ``--chaos_spec`` grammar is ``;``-joined entries, each one of
+three trigger forms on a registered point:
 
-    --chaos_spec='nan_grad@7;actor_raise@3:12;ckpt_torn@1;worker_kill@20'
+- ``point@i[:j:k...]`` — 1-based *occurrence indices*: the Nth
+  evaluation of that injection point fires.  Example::
 
-fires a NaN gradient on the 7th update, raises from an actor unroll on
-its 3rd and 12th evaluations, tears the 1st checkpoint save, and kills
-an env worker at the 20th unroll.  Occurrence counting is per-point and
-process-global (thread-safe), so a given spec replays the same faults
-at the same points every run — the property the chaos soak test
-(tests/test_chaos.py) is built on.  With no spec configured the
-injector is inert: every hot-path call is one attribute check.
+      --chaos_spec='nan_grad@7;actor_raise@3:12;ckpt_torn@1'
+
+  fires a NaN gradient on the 7th update, raises from an actor unroll
+  on its 3rd and 12th evaluations, and tears the 1st checkpoint save.
+- ``point@t=30s`` — *time trigger*: the first evaluation of the point
+  at or after 30 seconds of injector lifetime fires (the ``s`` suffix
+  is optional, floats are accepted).  Each time trigger fires at most
+  once.
+- ``point@p=0.01`` — *probability trigger*: every evaluation fires
+  with probability 0.01, drawn from a per-point RNG seeded from the
+  injector's ``seed`` — so a given (spec, seed) replays the same
+  decision sequence every run.
+
+Occurrence counting is per-point and process-global (thread-safe), so
+a given spec replays the same faults at the same points every run —
+the property the chaos soak test (tests/test_chaos.py) is built on.
+With no spec configured the injector is inert: every hot-path call is
+one attribute check.
+
+Beyond the arm-time spec there is a *runtime injection channel*: when
+the injector is built with ``channel_path`` (the driver wires
+``<logdir>/chaos_inject.jsonl`` under ``--chaos_channel``), each
+appended JSON line ``{"point": ..., "t_unix": ...}`` arms ONE firing
+of that point, consumed at the point's next evaluation — faults land
+in an already-running fleet, which is what the chaos soak engine
+(runtime/soak.py) drives.  Lines whose ``t_unix`` predates this
+injector's arm time are skipped, so a relaunched fleet epoch does not
+re-fire injections a dead epoch already consumed; an optional
+``"proc"`` field targets a single fleet process (matched against
+``process_id``).  The channel file is polled from ``should_fire`` at
+most every ``CHANNEL_POLL_S`` seconds.
 
 Every fired fault is breadcrumbed in the flight recorder (kind
-``fault``) and counted in ``faults/injected_total`` so a chaos run's
-artifacts show exactly which faults the recovery metrics answered.
+``fault``, with the trigger form) and counted in
+``faults/injected_total`` so a chaos run's artifacts show exactly
+which faults the recovery metrics answered.
 """
 
+import json
 import os
+import random
 import re
 import threading
-from typing import Dict, FrozenSet
+import time
+from typing import Dict, FrozenSet, List, NamedTuple, Tuple
 
 from scalable_agent_tpu.obs import get_flight_recorder, get_registry
 
 __all__ = [
+    "CHANNEL_NAME",
     "CHAOS_POINTS",
+    "ChaosSpec",
     "FaultInjector",
     "InjectedFault",
     "THROUGHPUT_SAG_S",
     "configure_faults",
     "get_fault_injector",
     "parse_chaos_spec",
+    "parse_chaos_spec_full",
     "throughput_sag_s",
 ]
 
@@ -119,6 +150,14 @@ CHAOS_POINTS = {
 }
 
 _ENTRY_RE = re.compile(r"([A-Za-z_][\w.]*)@(\d+(?::\d+)*)\Z")
+_TIME_RE = re.compile(r"([A-Za-z_][\w.]*)@t=(\d+(?:\.\d+)?)s?\Z")
+_PROB_RE = re.compile(r"([A-Za-z_][\w.]*)@p=(\d+(?:\.\d+)?)\Z")
+
+# The runtime injection channel: JSON lines appended to
+# ``<logdir>/CHANNEL_NAME`` arm one-shot firings in an already-running
+# process (see module docstring).  Polled at most this often.
+CHANNEL_NAME = "chaos_inject.jsonl"
+CHANNEL_POLL_S = 0.25
 
 # How long the ``throughput_sag`` point sleeps in the driver's update
 # loop when it fires.  Long enough that a log interval containing the
@@ -147,40 +186,100 @@ class InjectedFault(RuntimeError):
     it."""
 
 
-def parse_chaos_spec(spec: str) -> Dict[str, FrozenSet[int]]:
-    """``'nan_grad@7;actor_raise@3:12'`` -> {point: {occurrences}}.
+class ChaosSpec(NamedTuple):
+    """A fully parsed ``--chaos_spec``: occurrence sets, time triggers
+    (seconds of injector lifetime, each fires once), and per-evaluation
+    firing probabilities."""
+    occurrences: Dict[str, FrozenSet[int]]
+    at_times: Dict[str, Tuple[float, ...]]
+    probs: Dict[str, float]
+
+
+def parse_chaos_spec_full(spec: str) -> ChaosSpec:
+    """Parse every trigger form of the grammar (module docstring):
+    ``point@i[:j...]``, ``point@t=30s``, ``point@p=0.01``.
 
     Raises ``ValueError`` (with the grammar) on malformed entries —
     a silently-ignored typo would make a chaos run vacuously green.
     """
-    points: Dict[str, FrozenSet[int]] = {}
+    occurrences: Dict[str, FrozenSet[int]] = {}
+    at_times: Dict[str, Tuple[float, ...]] = {}
+    probs: Dict[str, float] = {}
     for entry in (spec or "").split(";"):
         entry = entry.strip()
         if not entry:
             continue
         match = _ENTRY_RE.match(entry)
-        if match is None:
-            raise ValueError(
-                f"malformed chaos_spec entry {entry!r}: expected "
-                f"'point@i[:j...]' with 1-based occurrence indices, "
-                f"e.g. 'nan_grad@7;actor_raise@3:12;ckpt_torn@1'")
-        name, occurrences = match.group(1), {
-            int(x) for x in match.group(2).split(":")}
-        if 0 in occurrences:
-            raise ValueError(
-                f"chaos_spec entry {entry!r}: occurrence indices are "
-                f"1-based")
-        points[name] = frozenset(occurrences) | points.get(
-            name, frozenset())
-    return points
+        if match is not None:
+            name, occs = match.group(1), {
+                int(x) for x in match.group(2).split(":")}
+            if 0 in occs:
+                raise ValueError(
+                    f"chaos_spec entry {entry!r}: occurrence indices "
+                    f"are 1-based")
+            occurrences[name] = frozenset(occs) | occurrences.get(
+                name, frozenset())
+            continue
+        match = _TIME_RE.match(entry)
+        if match is not None:
+            name = match.group(1)
+            at_times[name] = tuple(sorted(
+                at_times.get(name, ()) + (float(match.group(2)),)))
+            continue
+        match = _PROB_RE.match(entry)
+        if match is not None:
+            name, p = match.group(1), float(match.group(2))
+            if not 0.0 < p <= 1.0:
+                raise ValueError(
+                    f"chaos_spec entry {entry!r}: probability must be "
+                    f"in (0, 1]")
+            probs[name] = p
+            continue
+        raise ValueError(
+            f"malformed chaos_spec entry {entry!r}: expected "
+            f"'point@i[:j...]' (1-based occurrence indices), "
+            f"'point@t=30s' (time trigger), or 'point@p=0.01' "
+            f"(per-evaluation probability), e.g. "
+            f"'nan_grad@7;actor_raise@3:12;ckpt_torn@t=5s'")
+    return ChaosSpec(occurrences, at_times, probs)
+
+
+def parse_chaos_spec(spec: str) -> Dict[str, FrozenSet[int]]:
+    """``'nan_grad@7;actor_raise@3:12'`` -> {point: {occurrences}}.
+
+    The occurrence-trigger view of the grammar: time and probability
+    entries parse (and validate) but do not contribute occurrence
+    indices — in-graph consumers (``occurrences()``) bake occurrence
+    sets into compiled programs, where the other trigger forms cannot
+    apply.  Raises ``ValueError`` on malformed entries.
+    """
+    return parse_chaos_spec_full(spec).occurrences
 
 
 class FaultInjector:
-    """Occurrence-counting injection registry.  Deterministic: the Nth
-    evaluation of a point fires iff N is in the spec's list for it."""
+    """Trigger-evaluating injection registry.  Deterministic: the Nth
+    evaluation of a point fires iff N is in the spec's occurrence list,
+    a not-yet-consumed time trigger is due, a seeded per-point RNG draw
+    lands under the point's probability, or the runtime channel has a
+    pending arm for it (module docstring)."""
 
-    def __init__(self, spec: str = ""):
-        self._points = parse_chaos_spec(spec)
+    def __init__(self, spec: str = "", channel_path: str = None,
+                 seed: int = 0, process_id: int = 0):
+        parsed = parse_chaos_spec_full(spec)
+        self._points = parsed.occurrences
+        self._at_times: Dict[str, List[float]] = {
+            point: sorted(times)
+            for point, times in parsed.at_times.items()}
+        self._probs = parsed.probs
+        self._rngs = {point: random.Random(f"{seed}:{point}")
+                      for point in parsed.probs}
+        self._armed_monotonic = time.monotonic()
+        self._armed_unix = time.time()
+        self._process_id = process_id
+        self._channel_path = channel_path
+        self._channel_offset = 0
+        self._channel_next_poll = 0.0
+        self._pending: Dict[str, int] = {}
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -188,19 +287,82 @@ class FaultInjector:
     def active(self) -> bool:
         """False for the inert injector — hot paths gate on this so an
         unconfigured run pays one attribute read per injection point."""
-        return bool(self._points)
+        return bool(self._points or self._at_times or self._probs
+                    or self._channel_path)
+
+    def _poll_channel_locked(self):
+        """Consume newly appended channel lines into ``_pending``.
+        Byte-offset tailing; a torn final line (no trailing newline yet)
+        is left for the next poll."""
+        now = time.monotonic()
+        if now < self._channel_next_poll:
+            return
+        self._channel_next_poll = now + CHANNEL_POLL_S
+        try:
+            with open(self._channel_path, "rb") as f:
+                f.seek(self._channel_offset)
+                data = f.read()
+        except OSError:
+            return
+        if not data:
+            return
+        if not data.endswith(b"\n"):
+            cut = data.rfind(b"\n") + 1
+            if cut == 0:
+                return
+            data = data[:cut]
+        self._channel_offset += len(data)
+        for line in data.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                payload = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            point = payload.get("point") if isinstance(
+                payload, dict) else None
+            if not point:
+                continue
+            t_unix = payload.get("t_unix")
+            if t_unix is not None and t_unix < self._armed_unix:
+                continue  # consumed by a previous fleet epoch
+            proc = payload.get("proc")
+            if proc is not None and int(proc) != self._process_id:
+                continue
+            self._pending[point] = (
+                self._pending.get(point, 0)
+                + max(1, int(payload.get("count", 1))))
 
     def should_fire(self, point: str) -> bool:
-        """Count one evaluation of ``point``; True when this occurrence
-        is armed in the spec."""
-        if not self._points:
+        """Count one evaluation of ``point``; True when any trigger is
+        armed for this evaluation."""
+        if not self.active:
             return False
         with self._lock:
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
-        if n not in self._points.get(point, ()):
+            fired = None
+            if n in self._points.get(point, ()):
+                fired = "occurrence"
+            if fired is None:
+                due = self._at_times.get(point)
+                if due and due[0] <= (time.monotonic()
+                                      - self._armed_monotonic):
+                    self._at_times[point] = due[1:]
+                    fired = "time"
+            if fired is None and point in self._probs:
+                if self._rngs[point].random() < self._probs[point]:
+                    fired = "probability"
+            if fired is None and self._channel_path is not None:
+                self._poll_channel_locked()
+                if self._pending.get(point, 0) > 0:
+                    self._pending[point] -= 1
+                    fired = "channel"
+        if fired is None:
             return False
-        get_flight_recorder().record("fault", point, {"occurrence": n})
+        get_flight_recorder().record(
+            "fault", point, {"occurrence": n, "trigger": fired})
         get_registry().counter(
             "faults/injected_total",
             "faults fired by the chaos injection registry").inc()
@@ -239,11 +401,16 @@ def get_fault_injector() -> FaultInjector:
     return _injector
 
 
-def configure_faults(spec: str = "") -> FaultInjector:
+def configure_faults(spec: str = "", channel_path: str = None,
+                     seed: int = 0,
+                     process_id: int = 0) -> FaultInjector:
     """Install (and return) the process-global injector.  Empty spec
-    restores the inert injector — the driver calls that in teardown so
-    one chaos run can't leak faults into the next."""
+    with no channel restores the inert injector — the driver calls
+    that in teardown so one chaos run can't leak faults into the
+    next."""
     global _injector
     with _injector_lock:
-        _injector = FaultInjector(spec) if spec else _DISABLED
+        _injector = (FaultInjector(spec, channel_path=channel_path,
+                                   seed=seed, process_id=process_id)
+                     if (spec or channel_path) else _DISABLED)
         return _injector

@@ -771,8 +771,16 @@ class FleetMonitor:
             daemon=True, name="flightrec-dump")
         dumper.start()
         dumper.join(timeout=_DUMP_JOIN_S)
-        if self.num_processes > 1 and self.process_index == 0:
-            # Coordination-service host exits last (see __init__).
+        survivors = self.num_processes - 1 - len(lost_peers or [])
+        if self.num_processes > 1 and self.process_index == 0 \
+                and survivors > 0:
+            # Coordination-service host exits last (see __init__) —
+            # but only while another SURVIVOR still needs the service
+            # for its own verdict + dump.  When every other peer is
+            # already in the lost set (the 2-process reshard, a
+            # correlated N-process failure) the linger protects nobody
+            # and would sit squarely on the elastic supervisor's
+            # detect segment of MTTR.
             time.sleep(self._host_linger_s)
         self._on_fatal(FLEET_EXIT_CODE)
 

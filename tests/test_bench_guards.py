@@ -1235,3 +1235,75 @@ class TestSentinelRegressionGuard:
         diag = {"errors": [], "platform": "cpu"}
         bench.sentinel_regression_guard(diag, bench_dir=bench_dir)
         assert diag["errors"] == []
+
+
+class TestSoakRegressionGuard:
+    """ISSUE 20: the seeded chaos soak's graded verdict fails the
+    round on TPU when any SLO invariant broke, warns on the CPU
+    fallback, and — obs-guard-style — errors when a soak key the
+    previous round published goes missing."""
+
+    def _write_prev(self, tmp_path, platform="tpu", **keys):
+        artifact = {"metric": "learner_env_frames_per_sec_per_chip",
+                    "platform": platform, **keys}
+        (tmp_path / "BENCH_r09.json").write_text(
+            __import__("json").dumps(artifact))
+        return str(tmp_path)
+
+    def test_failed_soak_fails_on_tpu(self):
+        diag = {"errors": [], "platform": "tpu", "soak_pass": 0.0,
+                "soak_invariants": {"throughput_floor": False,
+                                    "mttr_ceiling": True},
+                "soak_throughput_floor_frac": 0.41,
+                "soak_points": ["nan_grad", "worker_kill"]}
+        bench.soak_regression_guard(diag)
+        assert any("SOAK" in e and "throughput_floor" in e
+                   for e in diag["errors"])
+
+    def test_failed_soak_warns_on_cpu_fallback(self):
+        diag = {"errors": [], "platform": "cpu", "soak_pass": 0.0,
+                "soak_invariants": {"quiet_outside_windows": False}}
+        bench.soak_regression_guard(diag)
+        assert diag["errors"] == []
+        assert any("SOAK" in w and "advisory" in w
+                   for w in diag["warnings"])
+
+    def test_passing_soak_is_silent(self):
+        diag = {"errors": [], "platform": "tpu", "soak_pass": 1.0,
+                "soak_invariants": {"throughput_floor": True}}
+        bench.soak_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_stage_never_ran_is_silent(self):
+        diag = {"errors": [], "platform": "tpu"}
+        bench.soak_regression_guard(diag)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_key_published_last_round_but_missing_now_fails(
+            self, tmp_path):
+        """soak_pass=0.0 last round is falsy but WAS published — its
+        disappearance must still flag (`is not None`, not truthiness,
+        unlike the frac-valued guards)."""
+        bench_dir = self._write_prev(
+            tmp_path, soak_pass=0.0, soak_throughput_floor_frac=0.9)
+        diag = {"errors": [], "platform": "tpu"}
+        bench.soak_regression_guard(diag, bench_dir=bench_dir)
+        missing = [e for e in diag["errors"]
+                   if "SOAK REGRESSION" in e and "missing" in e]
+        assert len(missing) == 2
+
+    def test_parity_with_previous_round_is_silent(self, tmp_path):
+        bench_dir = self._write_prev(
+            tmp_path, soak_pass=1.0, soak_throughput_floor_frac=0.93)
+        diag = {"errors": [], "platform": "tpu", "soak_pass": 1.0,
+                "soak_throughput_floor_frac": 0.91,
+                "soak_invariants": {"throughput_floor": True}}
+        bench.soak_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == [] and "warnings" not in diag
+
+    def test_silent_on_platform_mismatch(self, tmp_path):
+        bench_dir = self._write_prev(tmp_path, platform="tpu",
+                                     soak_pass=1.0)
+        diag = {"errors": [], "platform": "cpu"}
+        bench.soak_regression_guard(diag, bench_dir=bench_dir)
+        assert diag["errors"] == []

@@ -10,7 +10,9 @@ additionally marked ``slow``.
 
 import dataclasses
 import functools
+import json
 import os
+import time
 
 import jax
 import numpy as np
@@ -36,7 +38,12 @@ from scalable_agent_tpu.runtime import (
     get_fault_injector,
 )
 from scalable_agent_tpu.runtime.checkpoint import CheckpointManager
-from scalable_agent_tpu.runtime.faults import parse_chaos_spec
+from scalable_agent_tpu.runtime.faults import (
+    CHANNEL_NAME,
+    CHANNEL_POLL_S,
+    parse_chaos_spec,
+    parse_chaos_spec_full,
+)
 
 pytestmark = pytest.mark.chaos
 
@@ -135,6 +142,131 @@ class TestFaultInjector:
         assert get_fault_injector() is injector
         configure_faults("")
         assert not get_fault_injector().active
+
+
+class TestTriggerForms:
+    """ISSUE 20: the ``@t=`` and ``@p=`` trigger forms of the grammar
+    (the soak engine's schedule grammar shares them)."""
+
+    def test_full_grammar_parses_every_form(self):
+        parsed = parse_chaos_spec_full(
+            "nan_grad@7;ckpt_torn@t=5s;worker_kill@t=1.5;"
+            "actor_raise@p=0.25")
+        assert parsed.occurrences == {"nan_grad": frozenset({7})}
+        assert parsed.at_times == {"ckpt_torn": (5.0,),
+                                   "worker_kill": (1.5,)}
+        assert parsed.probs == {"actor_raise": 0.25}
+
+    def test_duplicate_time_triggers_merge_sorted(self):
+        parsed = parse_chaos_spec_full("p@t=5;p@t=2s")
+        assert parsed.at_times["p"] == (2.0, 5.0)
+
+    def test_occurrence_view_validates_but_drops_other_forms(self):
+        # In-graph consumers bake occurrence sets into compiled
+        # programs; time/probability entries still parse (a typo must
+        # not be silently dropped) but contribute no indices.
+        assert parse_chaos_spec("p@t=5;q@p=0.5;r@3") == {
+            "r": frozenset({3})}
+
+    @pytest.mark.parametrize("bad", ["p@t=", "p@p=", "p@t=5x",
+                                     "p@p=0", "p@p=1.5"])
+    def test_malformed_trigger_forms_raise(self, bad):
+        with pytest.raises(ValueError, match="chaos_spec"):
+            parse_chaos_spec_full(bad)
+
+    def test_time_trigger_fires_once_when_due(self):
+        injector = FaultInjector("p@t=0")
+        assert [injector.should_fire("p") for _ in range(3)] == [
+            True, False, False]
+
+    def test_time_trigger_not_yet_due_never_fires(self):
+        injector = FaultInjector("p@t=9999")
+        assert not any(injector.should_fire("p") for _ in range(3))
+
+    def test_stacked_time_triggers_fire_one_each(self):
+        injector = FaultInjector("p@t=0;p@t=0s")
+        assert [injector.should_fire("p") for _ in range(3)] == [
+            True, True, False]
+
+    def test_probability_trigger_replays_per_seed(self):
+        a = FaultInjector("p@p=0.5", seed=7)
+        b = FaultInjector("p@p=0.5", seed=7)
+        seq = [a.should_fire("p") for _ in range(32)]
+        assert [b.should_fire("p") for _ in range(32)] == seq
+        assert any(seq) and not all(seq)
+
+    def test_probability_one_always_fires(self):
+        injector = FaultInjector("p@p=1.0", seed=3)
+        assert all(injector.should_fire("p") for _ in range(5))
+
+
+class TestRuntimeChannel:
+    """ISSUE 20: the ``<logdir>/chaos_inject.jsonl`` runtime injection
+    channel — faults landing in an already-running process."""
+
+    @staticmethod
+    def _arm(path, point, **extra):
+        payload = {"point": point, "t_unix": time.time(), **extra}
+        with open(path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+
+    @pytest.fixture
+    def channel(self, tmp_path):
+        return str(tmp_path / CHANNEL_NAME)
+
+    def test_channel_only_injector_is_active(self, channel):
+        assert FaultInjector("", channel_path=channel).active
+
+    def test_line_arms_exactly_one_firing(self, channel):
+        injector = FaultInjector("", channel_path=channel)
+        self._arm(channel, "p")
+        assert injector.should_fire("p")
+        assert not injector.should_fire("p")
+
+    def test_count_field_arms_multiple_firings(self, channel):
+        injector = FaultInjector("", channel_path=channel)
+        self._arm(channel, "p", count=3)
+        assert [injector.should_fire("p") for _ in range(4)] == [
+            True, True, True, False]
+
+    def test_stale_line_from_a_dead_epoch_is_skipped(self, channel):
+        injector = FaultInjector("", channel_path=channel)
+        # A relaunched fleet epoch must not re-fire injections the dead
+        # epoch already consumed: t_unix predates this injector's arm.
+        self._arm(channel, "p")
+        with open(channel, "w") as f:
+            f.write(json.dumps(
+                {"point": "p", "t_unix": time.time() - 100.0}) + "\n")
+        assert not injector.should_fire("p")
+
+    def test_proc_targeting_matches_process_id(self, channel):
+        injector = FaultInjector("", channel_path=channel,
+                                 process_id=1)
+        self._arm(channel, "p", proc=0)
+        self._arm(channel, "p", proc=1)
+        # One poll consumes both lines; only the proc=1 arm is ours.
+        assert injector.should_fire("p")
+        assert not injector.should_fire("p")
+
+    def test_torn_final_line_is_deferred_not_dropped(self, channel):
+        injector = FaultInjector("", channel_path=channel)
+        payload = json.dumps({"point": "p", "t_unix": time.time()})
+        with open(channel, "w") as f:
+            f.write(payload[:10])  # crash-mid-append stand-in
+        assert not injector.should_fire("p")
+        with open(channel, "a") as f:
+            f.write(payload[10:] + "\n")
+        time.sleep(CHANNEL_POLL_S + 0.05)  # past the poll gate
+        assert injector.should_fire("p")
+
+    def test_garbage_lines_are_ignored(self, channel):
+        injector = FaultInjector("", channel_path=channel)
+        with open(channel, "w") as f:
+            f.write("not json\n")
+            f.write(json.dumps({"nope": 1}) + "\n")
+        self._arm(channel, "p")
+        assert injector.should_fire("p")
+        assert not injector.should_fire("p")
 
 
 # ---------------------------------------------------------------------------

@@ -148,6 +148,46 @@ def test_allowlists_have_no_stale_entries():
         f"them — the lists only shrink): {stale}")
 
 
+def test_trigger_forms_and_channel_are_documented():
+    """ISSUE 20 grew the grammar (``@t=``, ``@p=``) and added the
+    runtime injection channel; operators learn both from
+    docs/robustness.md, so their absence there is a regression exactly
+    like a missing fault-matrix row."""
+    text = open(ROBUSTNESS_MD).read()
+    missing = [needle for needle in
+               ("@t=", "@p=", "chaos_inject.jsonl", "--chaos_channel")
+               if needle not in text]
+    assert not missing, (
+        "chaos grammar/channel surface missing from docs/robustness.md "
+        f"(document the trigger form or channel): {missing}")
+
+
+def test_runtime_channel_stays_wired():
+    """The channel only works if the driver passes ``channel_path``
+    into ``configure_faults`` and the soak engine writes the same
+    file name — hold both ends to ``CHANNEL_NAME``."""
+    driver = open(os.path.join(PKG_DIR, "driver.py")).read()
+    assert "channel_path" in driver and "chaos_channel" in driver, (
+        "driver.py no longer wires the chaos runtime channel "
+        "(configure_faults(channel_path=...) under --chaos_channel)")
+    soak = open(os.path.join(PKG_DIR, "runtime", "soak.py")).read()
+    assert "CHANNEL_NAME" in soak, (
+        "runtime/soak.py no longer injects via the shared CHANNEL_NAME "
+        "channel file")
+
+
+def test_soak_grammar_is_documented():
+    """The soak engine's operator surface (running a chaos soak,
+    reading soak_report.json) must stay in docs/robustness.md."""
+    text = open(ROBUSTNESS_MD).read()
+    missing = [needle for needle in
+               ("runtime.soak", "soak_report.json", "mttr")
+               if needle not in text]
+    assert not missing, (
+        f"chaos-soak operator docs missing from docs/robustness.md: "
+        f"{missing}")
+
+
 def test_lint_actually_sees_the_known_sites():
     """The walker must FIND the known wiring (an AST bug that collects
     nothing would green-light everything)."""

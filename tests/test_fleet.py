@@ -280,6 +280,37 @@ class TestHeartbeats:
         assert monitor._host_linger_s == pytest.approx(
             fleet._DUMP_JOIN_S + 2.0 * monitor._poll_s + 1.0)
 
+    def test_host_linger_skipped_when_no_survivor_remains(
+            self, monkeypatch):
+        # ISSUE 20 MTTR engineering: with every other peer already in
+        # the lost set (the 2-process reshard) the linger protects
+        # nobody — it would sit squarely on the supervisor's detect
+        # segment.  With a survivor left (3-process, one lost), the
+        # host must still exit last.
+        slept = []
+        monkeypatch.setattr(fleet.time, "sleep",
+                            lambda s: slept.append(s))
+        clock, kv = Clock(), FakeKV()
+        fatals = []
+        monitor = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            process_index=0, num_processes=2, kv=kv, clock=clock,
+            on_fatal=fatals.append, host_exit_linger_s=7.5)
+        monitor._fatal("peer_lost", {"peers": {"1": 6.0}},
+                       lost_peers=[(1, 6.0)])
+        assert fatals == [exit_codes.FLEET_EXIT_CODE]
+        assert 7.5 not in slept
+
+        slept.clear()
+        survivor_case = FleetMonitor(
+            peer_timeout_s=5.0, registry=MetricsRegistry(),
+            process_index=0, num_processes=3, kv=FakeKV(),
+            clock=Clock(), on_fatal=fatals.append,
+            host_exit_linger_s=7.5)
+        survivor_case._fatal("peer_lost", {"peers": {"2": 6.0}},
+                             lost_peers=[(2, 6.0)])
+        assert 7.5 in slept
+
     def test_kv_recovery_resets_the_deadline(self):
         clock, kv = Clock(), FakeKV()
         alpha = make_monitor(clock, kv, proc=0, n=2)
